@@ -1,0 +1,24 @@
+"""racon-tpu: a TPU-native consensus / assembly-polishing framework.
+
+A from-scratch re-design of the capabilities of racon-gpu (lbcb-sci/racon
+v1.4.9 + NVIDIA CUDA acceleration; reference layout documented in SURVEY.md)
+built TPU-first:
+
+- ``racon_tpu.io``       — streaming FASTA/FASTQ/MHAP/PAF/SAM (+gzip) parsers
+  (reference: vendored ``bioparser``).
+- ``racon_tpu.core``     — domain model (Sequence / Overlap / Window) and the
+  Polisher pipeline driver (reference: ``src/sequence.cpp``,
+  ``src/overlap.cpp``, ``src/window.cpp``, ``src/polisher.cpp``).
+- ``racon_tpu.models``   — CPU reference algorithms: pairwise NW alignment and
+  partial-order-alignment consensus with spoa-faithful semantics (reference:
+  vendored ``edlib`` / ``spoa``).
+- ``racon_tpu.ops``      — JAX/XLA/Pallas batched kernels: wavefront NW with
+  traceback and batched POA over fixed-shape window batches (reference:
+  ``cudaaligner`` / ``cudapoa`` SDK usage in ``src/cuda/``).
+- ``racon_tpu.parallel`` — device-mesh dispatch (`shard_map` over windows =
+  reference's multi-GPU batch binning, ``src/cuda/cudapolisher.cpp:72-83``).
+- ``racon_tpu.native``   — C++ host core (fast NW fallback aligner, POA) with
+  ctypes bindings.
+"""
+
+__version__ = "0.1.0"

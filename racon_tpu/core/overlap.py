@@ -1,0 +1,292 @@
+"""Overlap domain object: one read <-> target mapping.
+
+Behavioural spec from the reference's ``src/overlap.cpp``:
+- three input formats with distinct constructors (MHAP ``overlap.cpp:15-27``,
+  PAF ``overlap.cpp:29-42``, SAM incl. CIGAR clip handling and strand flip
+  ``overlap.cpp:44-108``);
+- ``error = 1 - min(qspan, tspan) / max(qspan, tspan)``;
+- ``transmute`` resolves names/ids to indices in the loaded sequence set and
+  validates lengths (``overlap.cpp:129-177``);
+- ``find_breaking_points`` aligns (if no CIGAR) and walks the CIGAR emitting
+  per-window (first-match, last-match) coordinate pairs
+  (``overlap.cpp:179-292``).
+
+The CIGAR walk here is run-based (O(runs + window boundaries)) rather than the
+reference's per-base loop, with identical emitted pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.cigar import parse_cigar
+
+
+class Overlap:
+    __slots__ = (
+        "q_name", "q_id", "q_begin", "q_end", "q_length",
+        "t_name", "t_id", "t_begin", "t_end", "t_length",
+        "strand", "length", "error", "cigar",
+        "is_valid", "is_transmuted", "breaking_points",
+    )
+
+    def __init__(self):
+        self.q_name: Optional[bytes] = None
+        self.q_id: int = 0
+        self.q_begin = self.q_end = self.q_length = 0
+        self.t_name: Optional[bytes] = None
+        self.t_id: int = 0
+        self.t_begin = self.t_end = self.t_length = 0
+        self.strand = False
+        self.length = 0
+        self.error = 0.0
+        self.cigar: Optional[str] = None
+        self.is_valid = True
+        self.is_transmuted = False
+        self.breaking_points: List[Tuple[int, int]] = []
+
+    # ------------------------------------------------------------------ ctors
+
+    @classmethod
+    def from_paf(cls, q_name: bytes, q_length: int, q_begin: int, q_end: int,
+                 orientation: str, t_name: bytes, t_length: int, t_begin: int,
+                 t_end: int) -> "Overlap":
+        o = cls()
+        o.q_name, o.q_length, o.q_begin, o.q_end = q_name, q_length, q_begin, q_end
+        o.t_name, o.t_length, o.t_begin, o.t_end = t_name, t_length, t_begin, t_end
+        o.strand = orientation == "-"
+        o._set_error(q_end - q_begin, t_end - t_begin)
+        return o
+
+    @classmethod
+    def from_mhap(cls, a_id: int, b_id: int, a_rc: int, a_begin: int, a_end: int,
+                  a_length: int, b_rc: int, b_begin: int, b_end: int,
+                  b_length: int) -> "Overlap":
+        o = cls()
+        o.q_id, o.q_begin, o.q_end, o.q_length = a_id - 1, a_begin, a_end, a_length
+        o.t_id, o.t_begin, o.t_end, o.t_length = b_id - 1, b_begin, b_end, b_length
+        o.strand = bool(a_rc ^ b_rc)
+        o._set_error(o.q_end - o.q_begin, o.t_end - o.t_begin)
+        return o
+
+    @classmethod
+    def from_sam(cls, q_name: bytes, flag: int, t_name: bytes, pos: int,
+                 cigar: bytes) -> "Overlap":
+        o = cls()
+        o.q_name, o.t_name = q_name, t_name
+        o.t_begin = pos - 1
+        o.strand = bool(flag & 0x10)
+        o.is_valid = not (flag & 0x4)
+        cig = cigar.decode() if isinstance(cigar, bytes) else cigar
+        o.cigar = cig
+        if len(cig) < 2:
+            if o.is_valid:
+                raise ValueError("missing alignment from SAM record")
+            return o
+        runs = parse_cigar(cig)
+        # leading clip length gives q_begin (overlap.cpp:60-69)
+        q_begin = 0
+        for n, op in runs:
+            if op in ("S", "H"):
+                q_begin = n
+                break
+            if op in ("M", "=", "I", "D", "N", "P", "X"):
+                break
+        q_aln = q_clip = t_aln = 0
+        for n, op in runs:
+            if op in ("M", "=", "X"):
+                q_aln += n
+                t_aln += n
+            elif op == "I":
+                q_aln += n
+            elif op in ("D", "N"):
+                t_aln += n
+            elif op in ("S", "H"):
+                q_clip += n
+        o.q_begin = q_begin
+        o.q_end = q_begin + q_aln
+        o.q_length = q_clip + q_aln
+        if o.strand:
+            o.q_begin, o.q_end = o.q_length - o.q_end, o.q_length - o.q_begin
+        o.t_end = o.t_begin + t_aln
+        o._set_error(q_aln, t_aln)
+        return o
+
+    @classmethod
+    def from_record(cls, rec) -> "Overlap":
+        if rec.fmt == "paf":
+            qn, ql, qb, qe, strand, tn, tl, tb, te = rec.fields
+            return cls.from_paf(qn, ql, qb, qe, strand, tn, tl, tb, te)
+        if rec.fmt == "mhap":
+            a_id, b_id, _err, _minmers, a_rc, ab, ae, al, b_rc, bb, be, bl = rec.fields
+            return cls.from_mhap(a_id, b_id, a_rc, ab, ae, al, b_rc, bb, be, bl)
+        if rec.fmt == "sam":
+            qn, flag, tn, pos, cig = rec.fields
+            return cls.from_sam(qn, flag, tn, pos, cig)
+        raise ValueError(f"unknown overlap format {rec.fmt!r}")
+
+    def _set_error(self, q_span: int, t_span: int) -> None:
+        self.length = max(q_span, t_span)
+        self.error = 1 - min(q_span, t_span) / float(self.length) if self.length else 1.0
+
+    # ------------------------------------------------------------- transmute
+
+    def transmute(self, sequences, name_to_id: Dict[bytes, int],
+                  id_to_id: Dict[int, int]) -> None:
+        """Resolve names/raw ids to indices into ``sequences``.
+
+        Mirrors ``overlap.cpp:129-177``: queries looked up as name+'q' /
+        (id<<1|0), targets as name+'t' / (id<<1|1); length mismatches are
+        fatal; unknown names/ids just invalidate the overlap.
+        """
+        if not self.is_valid or self.is_transmuted:
+            return
+
+        if self.q_name is not None:
+            key = self.q_name + b"q"
+            if key not in name_to_id:
+                self.is_valid = False
+                return
+            self.q_id = name_to_id[key]
+            self.q_name = None
+        else:
+            key = self.q_id << 1 | 0
+            if key not in id_to_id:
+                self.is_valid = False
+                return
+            self.q_id = id_to_id[key]
+
+        if self.q_length != len(sequences[self.q_id].data):
+            raise ValueError(
+                f"unequal lengths in sequence and overlap file for sequence "
+                f"{sequences[self.q_id].name!r}")
+
+        if self.t_name is not None:
+            key = self.t_name + b"t"
+            if key not in name_to_id:
+                self.is_valid = False
+                return
+            self.t_id = name_to_id[key]
+            self.t_name = None
+        else:
+            key = self.t_id << 1 | 1
+            if key not in id_to_id:
+                self.is_valid = False
+                return
+            self.t_id = id_to_id[key]
+
+        if self.t_length != 0 and self.t_length != len(sequences[self.t_id].data):
+            raise ValueError(
+                f"unequal lengths in target and overlap file for target "
+                f"{sequences[self.t_id].name!r}")
+        self.t_length = len(sequences[self.t_id].data)
+        self.is_transmuted = True
+
+    # ------------------------------------------------- breaking points
+
+    def query_span_bytes(self, sequences) -> bytes:
+        """The query slice that participates in the alignment (strand-aware).
+
+        Mirrors the pointer selection at ``overlap.cpp:193-197``."""
+        seq = sequences[self.q_id]
+        if self.strand:
+            rc = seq.reverse_complement
+            return rc[self.q_length - self.q_end: self.q_length - self.q_begin]
+        return seq.data[self.q_begin: self.q_end]
+
+    def target_span_bytes(self, sequences) -> bytes:
+        return sequences[self.t_id].data[self.t_begin: self.t_end]
+
+    def find_breaking_points(self, sequences, window_length: int,
+                             aligner=None) -> None:
+        """Compute per-window (first-match, last-match) pairs.
+
+        If no CIGAR is present, ``aligner(q, t) -> cigar`` is used first
+        (reference: edlib NW at ``overlap.cpp:205-224``)."""
+        if not self.is_transmuted:
+            raise RuntimeError("overlap is not transmuted")
+        if self.breaking_points:
+            return
+        if not self.cigar:
+            if aligner is None:
+                raise RuntimeError("overlap has no CIGAR and no aligner given")
+            self.cigar = aligner(self.query_span_bytes(sequences),
+                                 self.target_span_bytes(sequences))
+        self.find_breaking_points_from_cigar(window_length)
+        self.cigar = None
+
+    def find_breaking_points_from_cigar(self, window_length: int) -> None:
+        """Run-based re-derivation of the per-base walk at
+        ``overlap.cpp:226-292``.
+
+        State: (q_ptr, t_ptr) point at the last consumed base of each
+        sequence; window boundaries are target positions ``i-1`` for every
+        multiple ``i`` of ``window_length`` in ``(t_begin, t_end)`` plus
+        ``t_end-1``. Whenever the target pointer crosses a boundary the pair
+        (first match after previous boundary, last match so far) is emitted —
+        provided a match was seen since the previous boundary.
+        """
+        window_ends: List[int] = []
+        i = 0
+        while i < self.t_end:
+            if i > self.t_begin:
+                window_ends.append(i - 1)
+            i += window_length
+        window_ends.append(self.t_end - 1)
+
+        w = 0
+        found_first = False
+        first = (0, 0)
+        last = (0, 0)
+        bp = self.breaking_points
+
+        q_ptr = (self.q_length - self.q_end if self.strand else self.q_begin) - 1
+        t_ptr = self.t_begin - 1
+
+        for n, op in parse_cigar(self.cigar):
+            if op in ("M", "=", "X"):
+                # Match run covering t positions t_ptr+1 .. t_ptr+n.
+                run_q, run_t = q_ptr, t_ptr
+                start_k = 1  # first base index within the run after last boundary
+                while w < len(window_ends) and window_ends[w] <= run_t + n:
+                    e = window_ends[w]
+                    if e <= run_t:
+                        # boundary already behind the run (can happen only if
+                        # it was exactly at run start handled by previous ops)
+                        if found_first:
+                            bp.append(first)
+                            bp.append(last)
+                        found_first = False
+                        w += 1
+                        continue
+                    k = e - run_t  # base count consumed to reach boundary
+                    if not found_first:
+                        first = (run_t + start_k, run_q + start_k)
+                    # last match at the boundary base itself
+                    bp.append(first)
+                    bp.append((e + 1, run_q + k + 1))
+                    found_first = False
+                    start_k = k + 1
+                    w += 1
+                # remaining bases of the run after the last in-run boundary
+                if start_k <= n:
+                    if not found_first:
+                        found_first = True
+                        first = (run_t + start_k, run_q + start_k)
+                    last = (run_t + n + 1, run_q + n + 1)
+                elif found_first:
+                    # run fully consumed by boundaries; nothing pending
+                    pass
+                q_ptr += n
+                t_ptr += n
+            elif op == "I":
+                q_ptr += n
+            elif op in ("D", "N"):
+                while w < len(window_ends) and window_ends[w] <= t_ptr + n:
+                    if found_first:
+                        bp.append(first)
+                        bp.append(last)
+                    found_first = False
+                    w += 1
+                t_ptr += n
+            # S/H/P consume nothing here (clips already folded into q_begin)

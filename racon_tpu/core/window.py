@@ -1,0 +1,103 @@
+"""Window domain object: one ~window_length slice of a target plus layered
+read fragments.
+
+Behavioural spec from the reference's ``src/window.cpp``:
+- the backbone slice is layer 0 with its (possibly dummy ``'!'``) quality;
+- ``add_layer`` validates bounds (``window.cpp:42-63``);
+- ``generate_consensus`` (``window.cpp:65-142``): <3 layers -> backbone
+  passthrough returning False; layers sorted by start position (stable, so
+  insertion order breaks ties); full-span layers (start < 1% of backbone
+  length, end > 99%) aligned to the whole graph, partial layers to the
+  subgraph spanning their positions; consensus coverage-trimmed at both ends
+  where coverage < floor(n_layers/2) for TGS windows.
+"""
+
+from __future__ import annotations
+
+import enum
+import sys
+from typing import List, Optional, Tuple
+
+
+class WindowType(enum.Enum):
+    NGS = 0  # short accurate reads (mean length <= 1000)
+    TGS = 1  # long noisy reads
+
+
+class Window:
+    __slots__ = ("id", "rank", "type", "consensus", "sequences", "qualities",
+                 "positions")
+
+    def __init__(self, id_: int, rank: int, type_: WindowType, backbone: bytes,
+                 quality: bytes):
+        if len(backbone) == 0 or len(backbone) != len(quality):
+            raise ValueError("empty backbone sequence/unequal quality length")
+        self.id = id_
+        self.rank = rank
+        self.type = type_
+        self.consensus: bytes = b""
+        self.sequences: List[bytes] = [backbone]
+        self.qualities: List[Optional[bytes]] = [quality]
+        self.positions: List[Tuple[int, int]] = [(0, 0)]
+
+    def add_layer(self, sequence: bytes, quality: Optional[bytes], begin: int,
+                  end: int) -> None:
+        if len(sequence) == 0 or begin == end:
+            return
+        if quality is not None and len(sequence) != len(quality):
+            raise ValueError("unequal quality size")
+        backbone_len = len(self.sequences[0])
+        if begin >= end or begin > backbone_len or end > backbone_len:
+            raise ValueError("layer begin and end positions are invalid")
+        self.sequences.append(sequence)
+        self.qualities.append(quality)
+        self.positions.append((begin, end))
+
+    def generate_consensus(self, engine, trim: bool) -> bool:
+        """Generate the consensus with the given POA engine.
+
+        ``engine`` provides the spoa-equivalent API used at
+        ``window.cpp:73-116``: ``create_graph()``, ``align(seq, graph)``,
+        graph ``add_alignment``/``subgraph``/``update_alignment``/
+        ``generate_consensus``.
+        """
+        if len(self.sequences) < 3:
+            self.consensus = self.sequences[0]
+            return False
+
+        graph = engine.create_graph()
+        graph.add_alignment([], self.sequences[0], self.qualities[0])
+
+        order = sorted(range(1, len(self.sequences)),
+                       key=lambda i: self.positions[i][0])
+
+        offset = int(0.01 * len(self.sequences[0]))
+        backbone_len = len(self.sequences[0])
+        for i in order:
+            begin, end = self.positions[i]
+            if begin < offset and end > backbone_len - offset:
+                alignment = engine.align(self.sequences[i], graph)
+            else:
+                subgraph, mapping = graph.subgraph(begin, end)
+                alignment = engine.align(self.sequences[i], subgraph)
+                alignment = subgraph.update_alignment(alignment, mapping)
+            graph.add_alignment(alignment, self.sequences[i], self.qualities[i])
+
+        consensus, coverages = graph.generate_consensus_with_coverage()
+
+        if self.type == WindowType.TGS and trim:
+            average_coverage = (len(self.sequences) - 1) // 2
+            begin, end = 0, len(consensus) - 1
+            while begin < len(consensus) and coverages[begin] < average_coverage:
+                begin += 1
+            while end >= 0 and coverages[end] < average_coverage:
+                end -= 1
+            if begin >= end:
+                print(f"[racon_tpu::Window::generate_consensus] warning: "
+                      f"contig {self.id} might be chimeric in window {self.rank}!",
+                      file=sys.stderr)
+            else:
+                consensus = consensus[begin:end + 1]
+
+        self.consensus = consensus
+        return True

@@ -1,0 +1,29 @@
+from .parsers import (
+    SequenceRecord,
+    OverlapRecord,
+    open_maybe_gzip,
+    parse_fasta,
+    parse_fastq,
+    parse_paf,
+    parse_mhap,
+    parse_sam,
+    sequence_parser_for,
+    overlap_parser_for,
+    SEQUENCE_EXTENSIONS,
+    OVERLAP_EXTENSIONS,
+)
+
+__all__ = [
+    "SequenceRecord",
+    "OverlapRecord",
+    "open_maybe_gzip",
+    "parse_fasta",
+    "parse_fastq",
+    "parse_paf",
+    "parse_mhap",
+    "parse_sam",
+    "sequence_parser_for",
+    "overlap_parser_for",
+    "SEQUENCE_EXTENSIONS",
+    "OVERLAP_EXTENSIONS",
+]

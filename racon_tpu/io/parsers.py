@@ -1,0 +1,177 @@
+"""Streaming FASTA/FASTQ/MHAP/PAF/SAM parsers with transparent gzip.
+
+Role-equivalent of the reference's vendored ``bioparser`` library (used via
+``bioparser::createParser`` at ``src/polisher.cpp:83-133``). Matches its
+observable behaviour:
+
+- names are truncated at the first whitespace character;
+- FASTA/FASTQ records may span multiple lines;
+- gzip is detected by magic bytes, not extension;
+- extension-based format dispatch lists live in ``SEQUENCE_EXTENSIONS`` /
+  ``OVERLAP_EXTENSIONS`` (mirrors ``src/polisher.cpp:83-133``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+SEQUENCE_EXTENSIONS = (
+    ".fasta", ".fasta.gz", ".fna", ".fna.gz", ".fa", ".fa.gz",
+    ".fastq", ".fastq.gz", ".fq", ".fq.gz",
+)
+FASTQ_EXTENSIONS = (".fastq", ".fastq.gz", ".fq", ".fq.gz")
+OVERLAP_EXTENSIONS = (".mhap", ".mhap.gz", ".paf", ".paf.gz", ".sam", ".sam.gz")
+
+
+@dataclass
+class SequenceRecord:
+    name: bytes
+    data: bytes
+    quality: Optional[bytes] = None  # None for FASTA
+
+
+@dataclass
+class OverlapRecord:
+    """Raw fields of one overlap line; interpretation happens in core.Overlap."""
+    fmt: str  # "paf" | "mhap" | "sam"
+    fields: tuple
+
+
+def open_maybe_gzip(path: str) -> io.BufferedReader:
+    f = open(path, "rb")
+    magic = f.peek(2)[:2]
+    if magic == b"\x1f\x8b":
+        f.close()
+        return io.BufferedReader(gzip.open(path))  # type: ignore[arg-type]
+    return f
+
+
+def _first_token(line: bytes) -> bytes:
+    return line.split(None, 1)[0] if line else b""
+
+
+def parse_fasta(path: str) -> Iterator[SequenceRecord]:
+    name = None
+    chunks: list = []
+    with open_maybe_gzip(path) as f:
+        for raw in f:
+            line = raw.rstrip()
+            if not line:
+                continue
+            if line.startswith(b">"):
+                if name is not None:
+                    yield SequenceRecord(name, b"".join(chunks))
+                name = _first_token(line[1:])
+                chunks = []
+            else:
+                chunks.append(line)
+        if name is not None:
+            yield SequenceRecord(name, b"".join(chunks))
+
+
+def parse_fastq(path: str) -> Iterator[SequenceRecord]:
+    """Multi-line-tolerant FASTQ: sequence lines until '+', then quality bytes
+    until their length matches the sequence length."""
+    with open_maybe_gzip(path) as f:
+        it = iter(f)
+        for raw in it:
+            header = raw.rstrip()
+            if not header:
+                continue
+            if not header.startswith(b"@"):
+                raise ValueError(f"malformed FASTQ header in {path}: {header[:40]!r}")
+            name = _first_token(header[1:])
+            seq_chunks = []
+            for raw in it:
+                line = raw.rstrip()
+                if line.startswith(b"+"):
+                    break
+                seq_chunks.append(line)
+            data = b"".join(seq_chunks)
+            qual_chunks = []
+            qlen = 0
+            while qlen < len(data):
+                try:
+                    line = next(it).rstrip()
+                except StopIteration:
+                    raise ValueError(
+                        f"truncated FASTQ record for {name!r} in {path}") from None
+                qual_chunks.append(line)
+                qlen += len(line)
+            quality = b"".join(qual_chunks)
+            if len(quality) != len(data):
+                raise ValueError(f"FASTQ quality/sequence length mismatch for {name!r}")
+            yield SequenceRecord(name, data, quality)
+
+
+def parse_paf(path: str) -> Iterator[OverlapRecord]:
+    """PAF: qname qlen qstart qend strand tname tlen tstart tend matches alen mapq [tags]."""
+    with open_maybe_gzip(path) as f:
+        for raw in f:
+            line = raw.rstrip()
+            if not line:
+                continue
+            t = line.split(b"\t")
+            yield OverlapRecord("paf", (
+                t[0], int(t[1]), int(t[2]), int(t[3]), t[4][:1].decode(),
+                t[5], int(t[6]), int(t[7]), int(t[8]),
+            ))
+
+
+def parse_mhap(path: str) -> Iterator[OverlapRecord]:
+    """MHAP: aid bid jaccard shared arc astart aend alen brc bstart bend blen (space-sep, 1-based ids)."""
+    with open_maybe_gzip(path) as f:
+        for raw in f:
+            line = raw.rstrip()
+            if not line:
+                continue
+            t = line.split()
+            yield OverlapRecord("mhap", (
+                int(t[0]), int(t[1]), float(t[2]), int(t[3]),
+                int(t[4]), int(t[5]), int(t[6]), int(t[7]),
+                int(t[8]), int(t[9]), int(t[10]), int(t[11]),
+            ))
+
+
+def parse_sam(path: str) -> Iterator[OverlapRecord]:
+    """SAM: qname flag rname pos mapq cigar ... (header lines skipped)."""
+    with open_maybe_gzip(path) as f:
+        for raw in f:
+            if raw.startswith(b"@"):
+                continue
+            line = raw.rstrip()
+            if not line:
+                continue
+            t = line.split(b"\t")
+            yield OverlapRecord("sam", (
+                t[0], int(t[1]), t[2], int(t[3]), t[5],
+            ))
+
+
+def _has_suffix(path: str, suffixes) -> bool:
+    return any(path.endswith(s) for s in suffixes)
+
+
+def sequence_parser_for(path: str):
+    """Extension dispatch for sequence files (``src/polisher.cpp:83-99``).
+
+    Returns a generator factory, or None for unsupported extensions."""
+    if _has_suffix(path, FASTQ_EXTENSIONS):
+        return parse_fastq
+    if _has_suffix(path, SEQUENCE_EXTENSIONS):
+        return parse_fasta
+    return None
+
+
+def overlap_parser_for(path: str):
+    """Extension dispatch for overlap files (``src/polisher.cpp:101-115``)."""
+    if _has_suffix(path, (".mhap", ".mhap.gz")):
+        return parse_mhap
+    if _has_suffix(path, (".paf", ".paf.gz")):
+        return parse_paf
+    if _has_suffix(path, (".sam", ".sam.gz")):
+        return parse_sam
+    return None

@@ -1,0 +1,4 @@
+from .poa import PoaAlignmentEngine, PoaGraph
+from .nw import edit_distance, nw_align
+
+__all__ = ["PoaAlignmentEngine", "PoaGraph", "edit_distance", "nw_align"]

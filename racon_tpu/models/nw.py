@@ -1,0 +1,188 @@
+"""Pairwise global alignment (edit distance) — CPU reference implementations.
+
+Role-equivalent of the reference's vendored ``edlib`` (Myers bit-vector NW
+with traceback, call sites ``src/overlap.cpp:205-224`` and the test metric
+``test/racon_test.cpp:16-25``):
+
+- ``edit_distance(a, b)`` — bit-parallel Myers/Hyyrö global edit distance
+  (score only), used as the consensus-quality oracle in tests;
+- ``nw_align(q, t)`` — banded unit-cost NW with traceback -> CIGAR
+  (band doubling until the optimum is provably inside the band), the Python
+  fallback aligner behind ``Overlap.find_breaking_points``.
+
+The fast paths are ``racon_tpu.native`` (C++) and ``racon_tpu.ops.nw``
+(batched TPU kernel); both are validated against these.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.cigar import alignment_path_to_cigar
+
+
+def edit_distance(a: bytes, b: bytes) -> int:
+    """Global (NW) edit distance via the bit-parallel Myers/Hyyrö algorithm.
+
+    Uses Python big-ints as the bit vectors; O(|a| * |b| / wordsize).
+    """
+    if isinstance(a, str):
+        a = a.encode()
+    if isinstance(b, str):
+        b = b.encode()
+    m = len(a)
+    if m == 0:
+        return len(b)
+    if len(b) == 0:
+        return m
+
+    peq = {}
+    for i, ch in enumerate(a):
+        peq[ch] = peq.get(ch, 0) | (1 << i)
+
+    mask = (1 << m) - 1
+    hi = 1 << (m - 1)
+    pv = mask
+    mv = 0
+    score = m
+    for ch in b:
+        eq = peq.get(ch, 0)
+        xv = eq | mv
+        xh = (((eq & pv) + pv) ^ pv) | eq
+        ph = mv | ~(xh | pv) & mask
+        mh = pv & xh
+        if ph & hi:
+            score += 1
+        if mh & hi:
+            score -= 1
+        ph = ((ph << 1) | 1) & mask
+        mh = (mh << 1) & mask
+        pv = (mh | ~(xv | ph)) & mask
+        mv = ph & xv
+    return score
+
+
+def nw_align(q: bytes, t: bytes, band: int | None = None) -> str:
+    """Banded unit-cost global alignment with traceback; returns a CIGAR
+    string (M for match and mismatch, like EDLIB_CIGAR_STANDARD).
+
+    The band is doubled until the optimal score provably fits inside it
+    (score <= band - |len difference| guarantees optimality for unit costs).
+    """
+    if isinstance(q, str):
+        q = q.encode()
+    if isinstance(t, str):
+        t = t.encode()
+    n, m = len(q), len(t)
+    if n == 0:
+        return f"{m}D" if m else ""
+    if m == 0:
+        return f"{n}I"
+
+    qa = np.frombuffer(q, dtype=np.uint8).astype(np.int16)
+    ta = np.frombuffer(t, dtype=np.uint8).astype(np.int16)
+
+    diff = abs(n - m)
+    if band is None:
+        band = max(32, diff + 8)
+    while True:
+        result = _banded_dp(qa, ta, band)
+        if result is not None:
+            score, cigar = result
+            if score <= band - diff or band >= max(n, m):
+                return cigar
+        band *= 2
+        if band > 2 * max(n, m):
+            band = max(n, m)
+
+
+def _banded_dp(qa: np.ndarray, ta: np.ndarray, band: int):
+    """Unit-cost NW restricted to a band around the length-scaled diagonal.
+    Rows = query (i), cols = target (j). Returns (score, cigar) or None if
+    the band end cell is unreachable."""
+    n, m = len(qa), len(ta)
+    big = np.int32(1 << 28)
+
+    # For row i, allowed j range: centered on i * m / n.
+    centers = (np.arange(n + 1, dtype=np.int64) * m) // max(n, 1)
+    lo = np.maximum(0, centers - band).astype(np.int64)
+    hi = np.minimum(m, centers + band).astype(np.int64)
+    width = int((hi - lo).max()) + 1
+
+    # dp row i stored as window [lo[i], hi[i]] inclusive, padded to `width`.
+    prev = np.full(width, big, dtype=np.int32)
+    w0 = int(hi[0] - lo[0]) + 1
+    prev[:w0] = np.arange(w0, dtype=np.int32)  # row 0: all-deletion prefix
+    prev_lo, prev_hi = int(lo[0]), int(hi[0])
+    # Direction codes: 0 diag (M), 1 up (I: consume query), 2 left (D).
+    dirs = np.zeros((n + 1, width), dtype=np.uint8)
+
+    for i in range(1, n + 1):
+        cur_lo, cur_hi = int(lo[i]), int(hi[i])
+        w = cur_hi - cur_lo + 1
+        jj = np.arange(cur_lo, cur_hi + 1, dtype=np.int64)
+
+        # prev-row lookups with bounds masking
+        pj1 = jj - 1 - prev_lo          # index of prev[j-1]
+        pju = jj - prev_lo              # index of prev[j]
+        ok1 = (jj - 1 >= prev_lo) & (jj - 1 <= prev_hi)
+        oku = (jj >= prev_lo) & (jj <= prev_hi)
+        diag = np.where(ok1, prev[np.clip(pj1, 0, width - 1)], big).astype(np.int64)
+        up = np.where(oku, prev[np.clip(pju, 0, width - 1)], big).astype(np.int64)
+
+        # substitution costs for j >= 1
+        j_start = max(cur_lo, 1)
+        sub = np.full(w, big, dtype=np.int64)
+        seg = (ta[j_start - 1: cur_hi] != qa[i - 1]).astype(np.int64)
+        sub[j_start - cur_lo:] = seg
+
+        costs_diag = np.where(jj >= 1, diag + sub, big)
+        costs_up = up + 1
+        cand = np.minimum(costs_diag, costs_up)
+        d = np.where(costs_diag <= costs_up, 0, 1).astype(np.uint8)
+        if cur_lo == 0:
+            cand[0] = i  # j == 0: only vertical moves
+            d[0] = 1
+
+        # left-move scan: row[k] = min(cand[k], row[k-1] + 1), vectorized as
+        # row[k] - k = running min of (cand[k'] - k').
+        ks = np.arange(w, dtype=np.int64)
+        scanned = np.minimum.accumulate(cand - ks) + ks
+        d = np.where(scanned < cand, np.uint8(2), d)
+        row = np.minimum(scanned, big)
+
+        prev = np.full(width, big, dtype=np.int32)
+        prev[:w] = row.astype(np.int32)
+        dirs[i, :w] = d
+        prev_lo, prev_hi = cur_lo, cur_hi
+
+    end_idx = m - int(lo[n])
+    if end_idx < 0 or end_idx > int(hi[n] - lo[n]):
+        return None
+    score = int(prev[end_idx])
+    if score >= big:
+        return None
+
+    # traceback
+    ops = []
+    i, j = n, m
+    while i > 0 or j > 0:
+        if i == 0:
+            ops.extend("D" * j)
+            break
+        k = j - int(lo[i])
+        d = dirs[i, k] if 0 <= k <= int(hi[i] - lo[i]) else 1
+        if j == 0:
+            d = 1
+        if d == 0:
+            ops.append("M")
+            i -= 1
+            j -= 1
+        elif d == 1:
+            ops.append("I")
+            i -= 1
+        else:
+            ops.append("D")
+            j -= 1
+    ops.reverse()
+    return score, alignment_path_to_cigar(ops)

@@ -1,0 +1,434 @@
+"""Partial-order alignment (POA) graph + NW sequence-to-graph aligner.
+
+CPU reference implementation with semantics faithful to the reference's
+vendored ``spoa`` library as used by racon (call sites
+``src/window.cpp:73-116``, ``src/polisher.cpp:180-184``):
+
+- linear-gap NW (kNW) sequence-to-graph alignment with traceback preferring
+  diagonal, then deletion (graph advance), then insertion, predecessors
+  visited in edge-insertion order;
+- quality-weighted graph edges: base weight = PHRED value (quality char - 33),
+  no quality -> weight 1; edge weight contribution = w[i-1] + w[i];
+- aligned-node fusion on ``add_alignment`` (same letter reuses the node or one
+  of its aligned nodes, otherwise a new node joins the aligned ring);
+- topological sort keeping aligned node groups consecutive in rank;
+- subgraph extraction for partial-span layers: backward DFS from the end node
+  through in-edges and aligned nodes, restricted to node ids >= begin node id;
+- consensus by heaviest-bundle traversal with branch completion; per-node
+  coverage = number of distinct sequence labels over the node's and its
+  aligned nodes' edges.
+
+This is the oracle the TPU kernels in ``racon_tpu.ops`` are validated
+against, and the CPU fallback path for windows the accelerator rejects
+(reference analog: ``src/cuda/cudapolisher.cpp:344-367``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+NEG_INF = -(2 ** 30)
+
+# PHRED offset used to convert quality chars to weights.
+QUALITY_BASE = 33
+
+AlignmentPair = Tuple[int, int]  # (node_id or -1, seq_pos or -1)
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "weight", "labels")
+
+    def __init__(self, src: int, dst: int, weight: int, label: int):
+        self.src = src
+        self.dst = dst
+        self.weight = weight
+        self.labels = [label]
+
+
+class PoaGraph:
+    def __init__(self):
+        self.letters: List[int] = []           # byte code per node
+        self.in_edges: List[List[_Edge]] = []  # insertion-ordered
+        self.out_edges: List[List[_Edge]] = []
+        self.aligned: List[List[int]] = []
+        self.num_sequences = 0
+        self.rank_to_node: List[int] = []
+        self.node_to_rank: List[int] = []
+        self.consensus_nodes: List[int] = []
+
+    # ------------------------------------------------------------- building
+
+    def add_node(self, letter: int) -> int:
+        self.letters.append(letter)
+        self.in_edges.append([])
+        self.out_edges.append([])
+        self.aligned.append([])
+        return len(self.letters) - 1
+
+    def add_edge(self, src: int, dst: int, weight: int) -> None:
+        for e in self.out_edges[src]:
+            if e.dst == dst:
+                e.weight += weight
+                e.labels.append(self.num_sequences)
+                return
+        e = _Edge(src, dst, weight, self.num_sequences)
+        self.out_edges[src].append(e)
+        self.in_edges[dst].append(e)
+
+    def _add_sequence_chain(self, seq: bytes, weights: Sequence[int],
+                            begin: int, end: int) -> Tuple[int, int]:
+        """Add seq[begin:end] as a fresh node chain; returns (first, last) ids
+        or (-1, -1) when the range is empty."""
+        if begin == end:
+            return -1, -1
+        first = self.add_node(seq[begin])
+        prev = first
+        for i in range(begin + 1, end):
+            node = self.add_node(seq[i])
+            self.add_edge(prev, node, weights[i - 1] + weights[i])
+            prev = node
+        return first, prev
+
+    @staticmethod
+    def weights_from_quality(seq_len: int, quality: Optional[bytes]) -> List[int]:
+        if quality is None:
+            return [1] * seq_len
+        return [q - QUALITY_BASE for q in quality]
+
+    def add_alignment(self, alignment: List[AlignmentPair], seq: bytes,
+                      quality: Optional[bytes] = None,
+                      weights: Optional[Sequence[int]] = None) -> None:
+        if len(seq) == 0:
+            return
+        if weights is None:
+            weights = self.weights_from_quality(len(seq), quality)
+
+        valid = [p for _, p in alignment if p != -1]
+        if not alignment or not valid:
+            self._add_sequence_chain(seq, weights, 0, len(seq))
+            self.num_sequences += 1
+            self._topological_sort()
+            return
+
+        _, head = self._add_sequence_chain(seq, weights, 0, valid[0])
+        tail_first, _ = self._add_sequence_chain(seq, weights, valid[-1] + 1, len(seq))
+
+        prev_weight = 0 if head == -1 else weights[valid[0] - 1]
+        for node_id, pos in alignment:
+            if pos == -1:
+                continue
+            letter = seq[pos]
+            if node_id == -1:
+                curr = self.add_node(letter)
+            elif self.letters[node_id] == letter:
+                curr = node_id
+            else:
+                curr = -1
+                for aid in self.aligned[node_id]:
+                    if self.letters[aid] == letter:
+                        curr = aid
+                        break
+                if curr == -1:
+                    curr = self.add_node(letter)
+                    for aid in self.aligned[node_id]:
+                        self.aligned[curr].append(aid)
+                        self.aligned[aid].append(curr)
+                    self.aligned[curr].append(node_id)
+                    self.aligned[node_id].append(curr)
+            if head != -1:
+                self.add_edge(head, curr, prev_weight + weights[pos])
+            head = curr
+            prev_weight = weights[pos]
+
+        if tail_first != -1:
+            self.add_edge(head, tail_first, prev_weight + weights[valid[-1] + 1])
+
+        self.num_sequences += 1
+        self._topological_sort()
+
+    # ------------------------------------------------------------- toposort
+
+    def _topological_sort(self) -> None:
+        """DFS toposort keeping aligned-node groups consecutive in rank."""
+        n = len(self.letters)
+        marks = bytearray(n)  # 0 unvisited, 2 done
+        check_aligned = [True] * n
+        rank_to_node: List[int] = []
+        for root in range(n):
+            if marks[root]:
+                continue
+            stack = [root]
+            while stack:
+                node = stack[-1]
+                valid = True
+                if marks[node] != 2:
+                    for e in self.in_edges[node]:
+                        if marks[e.src] != 2:
+                            stack.append(e.src)
+                            valid = False
+                    if check_aligned[node]:
+                        for aid in self.aligned[node]:
+                            if marks[aid] != 2:
+                                stack.append(aid)
+                                check_aligned[aid] = False
+                                valid = False
+                    if valid:
+                        marks[node] = 2
+                        if check_aligned[node]:
+                            rank_to_node.append(node)
+                            rank_to_node.extend(self.aligned[node])
+                if valid:
+                    stack.pop()
+        self.rank_to_node = rank_to_node
+        self.node_to_rank = [0] * n
+        for r, node in enumerate(rank_to_node):
+            self.node_to_rank[node] = r
+
+    # ------------------------------------------------------------- subgraph
+
+    def subgraph(self, begin_node: int, end_node: int) -> Tuple["PoaGraph", List[int]]:
+        """Extract the subgraph spanning backbone nodes [begin, end].
+
+        Backward DFS from ``end_node`` via in-edges and aligned nodes,
+        restricted to ids >= ``begin_node`` (backbone node ids equal backbone
+        positions because the backbone is added first). Returns (subgraph,
+        mapping) with ``mapping[sub_id] == original_id``.
+        """
+        marked = [False] * len(self.letters)
+        stack = [end_node]
+        while stack:
+            node = stack.pop()
+            if not marked[node] and node >= begin_node:
+                for e in self.in_edges[node]:
+                    stack.append(e.src)
+                for aid in self.aligned[node]:
+                    stack.append(aid)
+                marked[node] = True
+
+        mapping: List[int] = [i for i in range(len(self.letters)) if marked[i]]
+        orig_to_sub = {orig: s for s, orig in enumerate(mapping)}
+
+        sub = PoaGraph()
+        for orig in mapping:
+            sub.add_node(self.letters[orig])
+        for orig in mapping:
+            s_dst = orig_to_sub[orig]
+            for e in self.in_edges[orig]:
+                if marked[e.src]:
+                    edge = _Edge(orig_to_sub[e.src], s_dst, e.weight, 0)
+                    edge.labels = list(e.labels)
+                    sub.out_edges[orig_to_sub[e.src]].append(edge)
+                    sub.in_edges[s_dst].append(edge)
+            sub.aligned[s_dst] = [orig_to_sub[a] for a in self.aligned[orig]
+                                  if marked[a]]
+        sub.num_sequences = self.num_sequences
+        sub._topological_sort()
+        return sub, mapping
+
+    @staticmethod
+    def update_alignment(alignment: List[AlignmentPair],
+                         mapping: List[int]) -> List[AlignmentPair]:
+        return [(mapping[nid] if nid != -1 else -1, pos)
+                for nid, pos in alignment]
+
+    # ------------------------------------------------------------ consensus
+
+    def _node_coverage(self, node: int) -> int:
+        labels = set()
+        for e in self.in_edges[node]:
+            labels.update(e.labels)
+        for e in self.out_edges[node]:
+            labels.update(e.labels)
+        return len(labels)
+
+    def _traverse_heaviest_bundle(self) -> List[int]:
+        n = len(self.letters)
+        predecessors = [-1] * n
+        scores = [-1] * n
+        max_score_id = 0
+
+        for node in self.rank_to_node:
+            for e in self.in_edges[node]:
+                if (scores[node] < e.weight or
+                        (scores[node] == e.weight and
+                         scores[predecessors[node]] <= scores[e.src])):
+                    scores[node] = e.weight
+                    predecessors[node] = e.src
+            if predecessors[node] != -1:
+                scores[node] += scores[predecessors[node]]
+            if scores[max_score_id] < scores[node]:
+                max_score_id = node
+
+        guard = 0
+        while self.out_edges[max_score_id]:
+            max_score_id = self._branch_completion(
+                scores, predecessors, self.node_to_rank[max_score_id])
+            guard += 1
+            if guard > n:
+                raise RuntimeError("branch completion did not converge")
+
+        consensus = []
+        while predecessors[max_score_id] != -1:
+            consensus.append(max_score_id)
+            max_score_id = predecessors[max_score_id]
+        consensus.append(max_score_id)
+        consensus.reverse()
+        return consensus
+
+    def _branch_completion(self, scores: List[int], predecessors: List[int],
+                           rank: int) -> int:
+        node = self.rank_to_node[rank]
+        for e in self.out_edges[node]:
+            for oe in self.in_edges[e.dst]:
+                if oe.src != node:
+                    scores[oe.src] = -1
+
+        max_score = 0
+        max_score_id = 0
+        for i in range(rank + 1, len(self.rank_to_node)):
+            nid = self.rank_to_node[i]
+            scores[nid] = -1
+            predecessors[nid] = -1
+            for e in self.in_edges[nid]:
+                if scores[e.src] == -1:
+                    continue
+                if (scores[nid] < e.weight or
+                        (scores[nid] == e.weight and
+                         scores[predecessors[nid]] <= scores[e.src])):
+                    scores[nid] = e.weight
+                    predecessors[nid] = e.src
+            if predecessors[nid] != -1:
+                scores[nid] += scores[predecessors[nid]]
+            if max_score < scores[nid]:
+                max_score = scores[nid]
+                max_score_id = nid
+        return max_score_id
+
+    def generate_consensus_with_coverage(self) -> Tuple[bytes, List[int]]:
+        self.consensus_nodes = self._traverse_heaviest_bundle()
+        consensus = bytes(self.letters[nid] for nid in self.consensus_nodes)
+        coverages = []
+        for nid in self.consensus_nodes:
+            cov = self._node_coverage(nid)
+            for aid in self.aligned[nid]:
+                cov += self._node_coverage(aid)
+            coverages.append(cov)
+        return consensus, coverages
+
+    def generate_consensus(self) -> bytes:
+        return self.generate_consensus_with_coverage()[0]
+
+
+class PoaAlignmentEngine:
+    """Linear-gap NW sequence-to-graph aligner (spoa kNW equivalent)."""
+
+    def __init__(self, match: int = 3, mismatch: int = -5, gap: int = -4):
+        self.match = match
+        self.mismatch = mismatch
+        self.gap = gap
+
+    def create_graph(self) -> PoaGraph:
+        return PoaGraph()
+
+    def align(self, seq: bytes, graph: PoaGraph) -> List[AlignmentPair]:
+        if not graph.letters or len(seq) == 0:
+            return []
+
+        n = len(seq)
+        g = self.gap
+        seq_arr = np.frombuffer(seq, dtype=np.uint8)
+
+        # Per-letter match/mismatch profiles, built lazily.
+        profiles = {}
+
+        def profile(letter: int) -> np.ndarray:
+            p = profiles.get(letter)
+            if p is None:
+                p = np.where(seq_arr == letter, self.match, self.mismatch
+                             ).astype(np.int64)
+                profiles[letter] = p
+            return p
+
+        ranks = graph.rank_to_node
+        n_rows = len(ranks) + 1
+        H = np.empty((n_rows, n + 1), dtype=np.int64)
+        H[0] = np.arange(n + 1, dtype=np.int64) * g
+
+        j_idx = np.arange(n + 1, dtype=np.int64)
+        gap_ramp = j_idx * (-g)  # for the prefix-max insertion scan
+
+        node_to_rank = graph.node_to_rank
+        for r, node in enumerate(ranks, start=1):
+            prof = profile(graph.letters[node])
+            preds = graph.in_edges[node]
+            if not preds:
+                pred_rows = [0]
+            else:
+                pred_rows = [node_to_rank[e.src] + 1 for e in preds]
+            row = np.empty(n + 1, dtype=np.int64)
+            pr = H[pred_rows[0]]
+            row[0] = pr[0] + g
+            np.maximum(pr[:-1] + prof, pr[1:] + g, out=row[1:])
+            for pi in pred_rows[1:]:
+                pr = H[pi]
+                if pr[0] + g > row[0]:
+                    row[0] = pr[0] + g
+                np.maximum(row[1:], pr[:-1] + prof, out=row[1:])
+                np.maximum(row[1:], pr[1:] + g, out=row[1:])
+            # insertion scan: row[j] = max(row[j], row[j-1] + g)
+            shifted = row + gap_ramp
+            np.maximum.accumulate(shifted, out=shifted)
+            row = shifted - gap_ramp
+            H[r] = row
+
+        # Best end node (no out-edges) at the last column; first in rank wins.
+        max_i = -1
+        max_score = NEG_INF
+        for r, node in enumerate(ranks, start=1):
+            if not graph.out_edges[node]:
+                if H[r, n] > max_score:
+                    max_score = H[r, n]
+                    max_i = r
+        if max_i == -1:  # shouldn't happen in a DAG
+            max_i = n_rows - 1
+
+        # Traceback: diagonal first (preds in edge order), then deletion,
+        # then insertion.
+        alignment: List[AlignmentPair] = []
+        i, j = max_i, n
+        while not (i == 0 and j == 0):
+            h_ij = H[i, j]
+            prev_i = prev_j = -1
+            found = False
+            if i != 0 and j != 0:
+                node = ranks[i - 1]
+                cost = self.match if graph.letters[node] == seq[j - 1] else self.mismatch
+                preds = graph.in_edges[node]
+                pred_rows = [node_to_rank[e.src] + 1 for e in preds] if preds else [0]
+                for pi in pred_rows:
+                    if h_ij == H[pi, j - 1] + cost:
+                        prev_i, prev_j = pi, j - 1
+                        found = True
+                        break
+            if not found and i != 0:
+                node = ranks[i - 1]
+                preds = graph.in_edges[node]
+                pred_rows = [node_to_rank[e.src] + 1 for e in preds] if preds else [0]
+                for pi in pred_rows:
+                    if h_ij == H[pi, j] + g:
+                        prev_i, prev_j = pi, j
+                        found = True
+                        break
+            if not found and j != 0 and h_ij == H[i, j - 1] + g:
+                prev_i, prev_j = i, j - 1
+                found = True
+            if not found:
+                raise RuntimeError("POA traceback failed (inconsistent matrix)")
+            alignment.append((-1 if i == prev_i else ranks[i - 1],
+                              -1 if j == prev_j else j - 1))
+            i, j = prev_i, prev_j
+
+        alignment.reverse()
+        return alignment

@@ -1,0 +1,4 @@
+from .logger import Logger
+from .cigar import parse_cigar, cigar_to_string, alignment_path_to_cigar
+
+__all__ = ["Logger", "parse_cigar", "cigar_to_string", "alignment_path_to_cigar"]

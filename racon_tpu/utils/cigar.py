@@ -1,0 +1,58 @@
+"""CIGAR parsing/formatting helpers.
+
+The reference manipulates CIGAR strings produced by edlib
+(``src/overlap.cpp:205-224``), cudaaligner (``src/cuda/cudaaligner.cpp:101``)
+or taken from SAM input (``src/overlap.cpp:44-108``). Ops handled by the
+reference's walkers: M/=/X (match-ish), I, D/N, S/H (clips), P.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+_OPS = frozenset(b"MIDNSHP=X")
+
+
+def parse_cigar(cigar: str | bytes) -> List[Tuple[int, str]]:
+    """Parse a CIGAR string into ``[(length, op), ...]``."""
+    if isinstance(cigar, bytes):
+        cigar = cigar.decode()
+    runs: List[Tuple[int, str]] = []
+    num = 0
+    for ch in cigar:
+        if ch.isdigit():
+            num = num * 10 + ord(ch) - 48
+        else:
+            runs.append((num, ch))
+            num = 0
+    return runs
+
+
+def cigar_to_string(runs) -> str:
+    return "".join(f"{n}{op}" for n, op in runs)
+
+
+def alignment_path_to_cigar(path) -> str:
+    """Collapse a per-column move sequence into a CIGAR string.
+
+    ``path`` is an iterable of single-char ops ('M'/'=' /'X'/'I'/'D').
+    Equivalent in role to ``edlibAlignmentToCigar`` (EDLIB_CIGAR_STANDARD:
+    emits 'M' for both match and mismatch), used by the reference at
+    ``src/overlap.cpp:213-215``.
+    """
+    out = []
+    prev = None
+    count = 0
+    for op in path:
+        if op in ("=", "X"):
+            op = "M"
+        if op == prev:
+            count += 1
+        else:
+            if prev is not None:
+                out.append(f"{count}{prev}")
+            prev = op
+            count = 1
+    if prev is not None:
+        out.append(f"{count}{prev}")
+    return "".join(out)

@@ -1,0 +1,24 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so all
+sharding/parallel tests run without TPU hardware (the driver dry-runs the
+real multi-chip path separately via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+
+import pytest
+
+REFERENCE_DATA = pathlib.Path("/root/reference/test/data")
+
+
+@pytest.fixture(scope="session")
+def data_dir():
+    if not REFERENCE_DATA.exists():
+        pytest.skip("reference test data not available")
+    return REFERENCE_DATA

@@ -1,0 +1,95 @@
+"""Pairwise NW reference-implementation tests."""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu.models.nw import edit_distance, nw_align
+from racon_tpu.utils.cigar import parse_cigar
+
+
+def brute_edit_distance(a: bytes, b: bytes) -> int:
+    n, m = len(a), len(b)
+    dp = list(range(m + 1))
+    for i in range(1, n + 1):
+        prev = dp[0]
+        dp[0] = i
+        for j in range(1, m + 1):
+            cur = min(prev + (a[i - 1] != b[j - 1]), dp[j] + 1, dp[j - 1] + 1)
+            prev = dp[j]
+            dp[j] = cur
+    return dp[m]
+
+
+def random_pair(rng, n, err):
+    a = bytes(rng.choice(b"ACGT") for _ in range(n))
+    b = bytearray(a)
+    num_edits = int(n * err)
+    for _ in range(num_edits):
+        op = rng.randrange(3)
+        pos = rng.randrange(max(1, len(b)))
+        if op == 0:
+            b[pos:pos + 1] = bytes([rng.choice(b"ACGT")])
+        elif op == 1 and len(b) > 1:
+            del b[pos]
+        else:
+            b.insert(pos, rng.choice(b"ACGT"))
+    return a, bytes(b)
+
+
+def cigar_consumes(cigar: str):
+    q = t = 0
+    for n, op in parse_cigar(cigar):
+        if op == "M":
+            q += n
+            t += n
+        elif op == "I":
+            q += n
+        elif op == "D":
+            t += n
+    return q, t
+
+
+def cigar_cost(cigar: str, q: bytes, t: bytes) -> int:
+    qi = ti = cost = 0
+    for n, op in parse_cigar(cigar):
+        if op == "M":
+            for _ in range(n):
+                cost += q[qi] != t[ti]
+                qi += 1
+                ti += 1
+        elif op == "I":
+            qi += n
+            cost += n
+        elif op == "D":
+            ti += n
+            cost += n
+    return cost
+
+
+@pytest.mark.parametrize("n,err", [(10, 0.3), (50, 0.2), (200, 0.15), (500, 0.1)])
+def test_edit_distance_matches_bruteforce(n, err):
+    rng = random.Random(n)
+    for _ in range(5):
+        a, b = random_pair(rng, n, err)
+        assert edit_distance(a, b) == brute_edit_distance(a, b)
+
+
+@pytest.mark.parametrize("n,err", [(10, 0.3), (80, 0.2), (300, 0.15)])
+def test_nw_align_optimal_and_consistent(n, err):
+    rng = random.Random(n * 7)
+    for _ in range(5):
+        a, b = random_pair(rng, n, err)
+        cigar = nw_align(a, b)
+        cq, ct = cigar_consumes(cigar)
+        assert (cq, ct) == (len(a), len(b))
+        assert cigar_cost(cigar, a, b) == brute_edit_distance(a, b)
+
+
+def test_edge_cases():
+    assert edit_distance(b"", b"ACGT") == 4
+    assert edit_distance(b"ACGT", b"") == 4
+    assert edit_distance(b"ACGT", b"ACGT") == 0
+    assert nw_align(b"ACGT", b"ACGT") == "4M"
+    assert cigar_consumes(nw_align(b"", b"AC")) == (0, 2)

@@ -1,0 +1,161 @@
+"""Overlap semantics tests: constructors, transmute, breaking points.
+
+The run-based breaking-point walker is validated against a direct per-base
+re-implementation of the reference's loop (``src/overlap.cpp:226-292``)."""
+
+import random
+
+import pytest
+
+from racon_tpu.core.overlap import Overlap
+from racon_tpu.core.sequence import Sequence
+from racon_tpu.utils.cigar import parse_cigar
+
+
+def perbase_breaking_points(cigar, strand, q_begin, q_end, q_length,
+                            t_begin, t_end, window_length):
+    """Literal per-base transcription of the reference walker (oracle)."""
+    window_ends = []
+    i = 0
+    while i < t_end:
+        if i > t_begin:
+            window_ends.append(i - 1)
+        i += window_length
+    window_ends.append(t_end - 1)
+
+    w = 0
+    found = False
+    first = (0, 0)
+    last = (0, 0)
+    out = []
+    q_ptr = (q_length - q_end if strand else q_begin) - 1
+    t_ptr = t_begin - 1
+    for n, op in parse_cigar(cigar):
+        if op in ("M", "=", "X"):
+            for _ in range(n):
+                q_ptr += 1
+                t_ptr += 1
+                if not found:
+                    found = True
+                    first = (t_ptr, q_ptr)
+                last = (t_ptr + 1, q_ptr + 1)
+                if w < len(window_ends) and t_ptr == window_ends[w]:
+                    if found:
+                        out.append(first)
+                        out.append(last)
+                    found = False
+                    w += 1
+        elif op == "I":
+            q_ptr += n
+        elif op in ("D", "N"):
+            for _ in range(n):
+                t_ptr += 1
+                if w < len(window_ends) and t_ptr == window_ends[w]:
+                    if found:
+                        out.append(first)
+                        out.append(last)
+                    found = False
+                    w += 1
+    return out
+
+
+def random_cigar(rng, approx_len):
+    ops = []
+    total_t = 0
+    while total_t < approx_len:
+        op = rng.choices(["M", "I", "D"], weights=[8, 1, 1])[0]
+        n = rng.randint(1, 30)
+        ops.append(f"{n}{op}")
+        if op in ("M", "D"):
+            total_t += n
+    return "".join(ops), total_t
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_breaking_points_match_perbase_walker(seed):
+    rng = random.Random(seed)
+    window_length = rng.choice([25, 100, 500])
+    t_begin = rng.randint(0, 700)
+    cigar, t_span = random_cigar(rng, rng.randint(40, 2000))
+    t_end = t_begin + t_span
+    # q span derived from cigar
+    q_span = sum(n for n, op in parse_cigar(cigar) if op in ("M", "I"))
+    strand = rng.random() < 0.5
+    q_begin = rng.randint(0, 50)
+    q_end = q_begin + q_span
+    q_length = q_end + rng.randint(0, 50)
+
+    o = Overlap()
+    o.q_begin, o.q_end, o.q_length = q_begin, q_end, q_length
+    o.t_begin, o.t_end = t_begin, t_end
+    o.strand = strand
+    o.cigar = cigar
+    o.is_transmuted = True
+    o.find_breaking_points_from_cigar(window_length)
+
+    expected = perbase_breaking_points(
+        cigar, strand, q_begin, q_end, q_length, t_begin, t_end, window_length)
+    assert o.breaking_points == expected
+
+
+def test_paf_ctor_error():
+    o = Overlap.from_paf(b"q", 100, 10, 90, "+", b"t", 200, 20, 120)
+    assert o.length == 100
+    assert o.error == pytest.approx(1 - 80 / 100)
+    assert not o.strand
+
+
+def test_mhap_ctor_ids_are_one_based():
+    o = Overlap.from_mhap(1, 2, 0, 10, 90, 100, 1, 20, 120, 200)
+    assert o.q_id == 0 and o.t_id == 1
+    assert o.strand  # 0 ^ 1
+
+
+def test_sam_ctor_clips_and_strand():
+    # 5S10M2I3D5M3S on forward strand
+    o = Overlap.from_sam(b"q", 0, b"t", 101, b"5S10M2I3D5M3S")
+    assert o.t_begin == 100
+    assert o.q_begin == 5
+    assert o.q_end == 5 + 10 + 2 + 5
+    assert o.q_length == 5 + 17 + 3
+    assert o.t_end == 100 + 10 + 3 + 5
+    # reverse strand flips q coords
+    o2 = Overlap.from_sam(b"q", 16, b"t", 101, b"5S10M2I3D5M3S")
+    assert o2.strand
+    assert o2.q_begin == o2.q_length - o.q_end
+    assert o2.q_end == o2.q_length - o.q_begin
+
+
+def test_sam_unmapped_is_invalid():
+    o = Overlap.from_sam(b"q", 4, b"t", 0, b"*")
+    assert not o.is_valid
+
+
+def test_transmute_by_name():
+    seqs = [Sequence(b"t1", b"A" * 200), Sequence(b"r1", b"C" * 100)]
+    name_to_id = {b"t1t": 0, b"t1q": 0, b"r1q": 1}
+    o = Overlap.from_paf(b"r1", 100, 10, 90, "+", b"t1", 200, 20, 120)
+    o.transmute(seqs, name_to_id, {})
+    assert o.is_transmuted and o.q_id == 1 and o.t_id == 0
+
+    o2 = Overlap.from_paf(b"unknown", 100, 10, 90, "+", b"t1", 200, 20, 120)
+    o2.transmute(seqs, name_to_id, {})
+    assert not o2.is_valid
+
+
+def test_transmute_length_mismatch_raises():
+    seqs = [Sequence(b"t1", b"A" * 200), Sequence(b"r1", b"C" * 100)]
+    name_to_id = {b"t1t": 0, b"r1q": 1}
+    o = Overlap.from_paf(b"r1", 999, 10, 90, "+", b"t1", 200, 20, 120)
+    with pytest.raises(ValueError):
+        o.transmute(seqs, name_to_id, {})
+
+
+def test_query_span_strand():
+    s = Sequence(b"r", b"AACCGGTT")
+    seqs = [Sequence(b"t", b"A" * 8), s]
+    o = Overlap.from_paf(b"r", 8, 2, 6, "-", b"t", 8, 0, 4)
+    o.q_id, o.t_id = 1, 0
+    o.is_transmuted = True
+    # reverse complement of AACCGGTT = AACCGGTT
+    assert o.query_span_bytes(seqs) == s.reverse_complement[2:6]

@@ -1,0 +1,60 @@
+"""Parser tests on the reference's λ-phage dataset (read in place from
+/root/reference/test/data — public test fixtures, not code)."""
+
+from racon_tpu.io import (
+    parse_fasta, parse_fastq, parse_paf, parse_mhap, parse_sam,
+    sequence_parser_for, overlap_parser_for,
+)
+
+
+def test_fasta_layout(data_dir):
+    recs = list(parse_fasta(str(data_dir / "sample_layout.fasta.gz")))
+    assert len(recs) == 1
+    assert recs[0].name == b"utg000001l"
+    assert len(recs[0].data) == 47564
+    assert recs[0].quality is None
+
+
+def test_fastq_reads_multiline(data_dir):
+    recs = list(parse_fastq(str(data_dir / "sample_reads.fastq.gz")))
+    assert len(recs) > 100
+    for r in recs:
+        assert len(r.data) == len(r.quality)
+    total = sum(len(r.data) for r in recs)
+    assert total > 1_000_000  # ~1.6 Mbp of ONT reads
+
+
+def test_paf(data_dir):
+    recs = list(parse_paf(str(data_dir / "sample_overlaps.paf.gz")))
+    assert len(recs) == 181
+    qn, ql, qb, qe, strand, tn, tl, tb, te = recs[0].fields
+    assert tn == b"utg000001l" and tl == 47564
+    assert strand in "+-"
+    assert 0 <= qb < qe <= ql
+
+
+def test_mhap(data_dir):
+    recs = list(parse_mhap(str(data_dir / "sample_ava_overlaps.mhap.gz")))
+    assert len(recs) > 1000
+    a_id, b_id, _, _, a_rc, ab, ae, al, b_rc, bb, be, bl = recs[0].fields
+    assert a_id >= 1 and b_id >= 1
+    assert a_rc in (0, 1) and b_rc in (0, 1)
+
+
+def test_sam(data_dir):
+    recs = list(parse_sam(str(data_dir / "sample_overlaps.sam.gz")))
+    assert len(recs) > 100
+    qn, flag, tn, pos, cigar = recs[0].fields
+    assert tn == b"utg000001l"
+    assert pos >= 1
+    assert any(c in b"MIDSH=X" for c in cigar)
+
+
+def test_dispatch():
+    assert sequence_parser_for("x.fasta.gz") is parse_fasta
+    assert sequence_parser_for("x.fq") is parse_fastq
+    assert sequence_parser_for("x.bam") is None
+    assert overlap_parser_for("x.paf.gz") is parse_paf
+    assert overlap_parser_for("x.mhap") is parse_mhap
+    assert overlap_parser_for("x.sam.gz") is parse_sam
+    assert overlap_parser_for("x.vcf") is None

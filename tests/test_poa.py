@@ -1,0 +1,97 @@
+"""POA graph/consensus tests against hand-checkable cases."""
+
+import pytest
+
+from racon_tpu.models.poa import PoaAlignmentEngine, PoaGraph
+
+
+@pytest.fixture
+def engine():
+    return PoaAlignmentEngine(match=3, mismatch=-5, gap=-4)
+
+
+def build_graph(engine, seqs, quals=None):
+    graph = engine.create_graph()
+    quals = quals or [None] * len(seqs)
+    graph.add_alignment([], seqs[0], quals[0])
+    for s, q in zip(seqs[1:], quals[1:]):
+        aln = engine.align(s, graph)
+        graph.add_alignment(aln, s, q)
+    return graph
+
+
+def test_single_sequence_roundtrip(engine):
+    g = build_graph(engine, [b"ACGTACGT"])
+    assert g.generate_consensus() == b"ACGTACGT"
+
+
+def test_identical_sequences(engine):
+    g = build_graph(engine, [b"ACGTACGT"] * 5)
+    assert g.generate_consensus() == b"ACGTACGT"
+    # one linear chain: 8 nodes only
+    assert len(g.letters) == 8
+
+
+def test_majority_substitution(engine):
+    seqs = [b"ACGTACGT", b"ACGAACGT", b"ACGAACGT", b"ACGAACGT"]
+    g = build_graph(engine, seqs)
+    assert g.generate_consensus() == b"ACGAACGT"
+
+
+def test_majority_insertion_deletion(engine):
+    seqs = [b"ACGTT", b"ACGTT", b"ACGT", b"ACGTT"]
+    g = build_graph(engine, seqs)
+    assert g.generate_consensus() == b"ACGTT"
+    seqs = [b"ACGTT", b"ACGT", b"ACGT", b"ACGT"]
+    g = build_graph(engine, seqs)
+    assert g.generate_consensus() == b"ACGT"
+
+
+def test_quality_weights_break_ties(engine):
+    # Two variants, equal counts; higher-quality bases should win.
+    hi = bytes([33 + 40] * 4)
+    lo = bytes([33 + 2] * 4)
+    g = build_graph(engine, [b"ACGT", b"AGGT", b"ACGT", b"AGGT"],
+                    quals=[lo, hi, lo, hi])
+    assert g.generate_consensus() == b"AGGT"
+
+
+def test_alignment_pairs_wellformed(engine):
+    g = build_graph(engine, [b"ACGTACGTAA"])
+    aln = engine.align(b"ACGTTACGT", g)
+    # every pair references a valid node/position
+    seq_positions = [p for _, p in aln if p != -1]
+    assert seq_positions == sorted(seq_positions)
+    assert seq_positions[0] == 0 and seq_positions[-1] == 8
+    node_ids = [n for n, _ in aln if n != -1]
+    assert all(0 <= n < len(g.letters) for n in node_ids)
+
+
+def test_coverage_counts(engine):
+    g = build_graph(engine, [b"ACGT"] * 4)
+    consensus, cov = g.generate_consensus_with_coverage()
+    assert consensus == b"ACGT"
+    assert cov == [4, 4, 4, 4]
+
+
+def test_subgraph_partial_layer(engine):
+    backbone = b"AAAACCCCGGGGTTTT"
+    g = engine.create_graph()
+    g.add_alignment([], backbone, None)
+    # layer covering backbone positions 4..11 ("CCCCGGGG")
+    sub, mapping = g.subgraph(4, 11)
+    assert bytes(sub.letters) == b"CCCCGGGG"
+    aln = engine.align(b"CCCCGGGG", sub)
+    aln = sub.update_alignment(aln, mapping)
+    g.add_alignment(aln, b"CCCCGGGG", None)
+    # no new nodes should have been created (perfect match onto backbone)
+    assert len(g.letters) == len(backbone)
+    assert g.generate_consensus() == backbone
+
+
+def test_mismatch_creates_aligned_node(engine):
+    g = build_graph(engine, [b"ACGT", b"ATGT"])
+    # position 1: C and T aligned -> 5 nodes, C/T in one aligned ring
+    assert len(g.letters) == 5
+    rings = [r for r in g.aligned if r]
+    assert len(rings) == 2
