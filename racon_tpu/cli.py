@@ -1,0 +1,126 @@
+"""`racon` command-line interface.
+
+Same contract as the reference CLI (``src/main.cpp:22-222``): positional
+``<sequences> <overlaps> <target sequences>``, identical option names and
+defaults, FASTA written to stdout as ``>{name}{tags}\\n{data}``. The
+accelerator knobs mirror the reference's CUDA flags with TPU naming:
+``--tpupoa-batches`` (= ``-c/--cudapoa-batches``), ``--tpu-banded-alignment``
+(= ``-b``), ``--tpualigner-batches`` (= ``--cudaaligner-batches``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import __version__
+from .core.polisher import PolisherType, create_polisher
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="racon",
+        description="consensus module for raw de novo DNA assembly of long "
+                    "uncorrected reads (TPU-native implementation)")
+    p.add_argument("sequences", help="FASTA/FASTQ file (may be gzipped) with "
+                                     "sequences used for correction")
+    p.add_argument("overlaps", help="MHAP/PAF/SAM file (may be gzipped) with "
+                                    "overlaps between sequences and targets")
+    p.add_argument("target_sequences", help="FASTA/FASTQ file (may be "
+                                            "gzipped) with targets to correct")
+    p.add_argument("-u", "--include-unpolished", action="store_true",
+                   help="output unpolished target sequences")
+    p.add_argument("-f", "--fragment-correction", action="store_true",
+                   help="perform fragment correction instead of contig "
+                        "polishing (overlaps file should contain dual/self "
+                        "overlaps!)")
+    p.add_argument("-w", "--window-length", type=int, default=500,
+                   help="size of window on which POA is performed")
+    p.add_argument("-q", "--quality-threshold", type=float, default=10.0,
+                   help="threshold for average base quality of windows used "
+                        "in POA")
+    p.add_argument("-e", "--error-threshold", type=float, default=0.3,
+                   help="maximum allowed error rate used for filtering "
+                        "overlaps")
+    p.add_argument("--no-trimming", action="store_true",
+                   help="disables consensus trimming at window ends")
+    p.add_argument("-m", "--match", type=int, default=3,
+                   help="score for matching bases")
+    p.add_argument("-x", "--mismatch", type=int, default=-5,
+                   help="score for mismatching bases")
+    p.add_argument("-g", "--gap", type=int, default=-4,
+                   help="gap penalty (must be negative)")
+    p.add_argument("-t", "--threads", type=int, default=1,
+                   help="number of threads")
+    p.add_argument("--version", action="version", version=__version__)
+    # TPU acceleration knobs (reference analog: -c/-b/--cudaaligner-batches)
+    p.add_argument("-c", "--tpupoa-batches", type=int, nargs="?", const=1,
+                   default=0,
+                   help="number of batches for TPU accelerated polishing")
+    p.add_argument("-b", "--tpu-banded-alignment", action="store_true",
+                   help="use banding approximation for alignment on the TPU")
+    p.add_argument("--tpualigner-batches", type=int, default=0,
+                   help="number of batches for TPU accelerated alignment")
+    return p
+
+
+def _preprocess_argv(argv):
+    """Make ``-c`` consume a following token only when it is an integer,
+    matching the reference's getopt optional-argument handling
+    (``src/main.cpp:111-123``) without argparse's greedy ``nargs='?'``."""
+    out = []
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if tok in ("-c", "--tpupoa-batches"):
+            nxt = argv[i + 1] if i + 1 < len(argv) else None
+            if nxt is not None and not nxt.startswith("-"):
+                try:
+                    int(nxt)
+                except ValueError:
+                    out.append(f"--tpupoa-batches=1")
+                    i += 1
+                    continue
+        out.append(tok)
+        i += 1
+    return out
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    args = build_parser().parse_args(_preprocess_argv(list(argv)))
+
+    try:
+        polisher = create_polisher(
+            args.sequences, args.overlaps, args.target_sequences,
+            PolisherType.F if args.fragment_correction else PolisherType.C,
+            window_length=args.window_length,
+            quality_threshold=args.quality_threshold,
+            error_threshold=args.error_threshold,
+            trim=not args.no_trimming,
+            match=args.match, mismatch=args.mismatch, gap=args.gap,
+            num_threads=args.threads,
+            aligner_backend="tpu" if args.tpualigner_batches > 0 else "auto",
+            consensus_backend="tpu" if args.tpupoa_batches > 0 else "auto",
+        )
+    except (ValueError, ImportError) as e:
+        print(f"[racon::createPolisher] error: {e}", file=sys.stderr)
+        return 1
+
+    try:
+        polisher.initialize()
+        polished = polisher.polish(not args.include_unpolished)
+    except (ValueError, RuntimeError, OSError) as e:
+        print(f"[racon::] error: {e}", file=sys.stderr)
+        return 1
+
+    out = sys.stdout.buffer
+    for seq in polished:
+        out.write(b">" + seq.name + b"\n" + seq.data + b"\n")
+    out.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

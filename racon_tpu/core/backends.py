@@ -1,0 +1,94 @@
+"""Pluggable compute backends for the polishing pipeline.
+
+The reference dispatches CPU (edlib/spoa) vs GPU (cudaaligner/cudapoa) inside
+``createPolisher`` (``src/polisher.cpp:135-158``) and routes accelerator
+rejects back to the CPU path (``src/cuda/cudapolisher.cpp:195-199,344-367``).
+Here the same seams are explicit backend objects:
+
+- ``AlignerBackend.align_batch(pairs) -> cigars`` fills the role of
+  CUDABatchAligner (``src/cuda/cudaaligner.cpp``);
+- ``ConsensusBackend.run(windows, trim) -> polished flags`` fills the role of
+  CUDABatchProcessor (``src/cuda/cudabatch.cpp``).
+
+TPU implementations live in ``racon_tpu.ops`` and are selected with
+``backend="tpu"``; every TPU backend keeps the CPU implementation as its
+reject-fallback, mirroring the reference's contract.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ..models.nw import nw_align
+from ..models.poa import PoaAlignmentEngine
+from .. import native
+
+
+class PythonAligner:
+    """Pure-Python banded NW (fallback of last resort)."""
+
+    def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[str]:
+        return [nw_align(q, t) for q, t in pairs]
+
+
+class NativeAligner:
+    """C++ banded NW with an internal dynamic work queue over threads
+    (host analog of the reference's batch fill/process loop,
+    ``src/cuda/cudapolisher.cpp:98-160``)."""
+
+    def __init__(self, num_threads: int = 1):
+        self.num_threads = num_threads
+        if not native.available():
+            raise RuntimeError("native library unavailable")
+
+    def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[str]:
+        return native.nw_cigar_batch(list(pairs), num_threads=self.num_threads)
+
+
+class CpuPoaConsensus:
+    """Spoa-semantics POA over windows (reference CPU path,
+    ``src/polisher.cpp:490-503``). The Python engine is sequential (GIL);
+    ``num_threads`` is honored once the native C++ POA engine is selected.
+    """
+
+    def __init__(self, match: int, mismatch: int, gap: int,
+                 num_threads: int = 1):
+        self.engine = PoaAlignmentEngine(match, mismatch, gap)
+        self.num_threads = num_threads
+
+    def run(self, windows, trim: bool) -> List[bool]:
+        return [w.generate_consensus(self.engine, trim) for w in windows]
+
+
+def make_aligner(backend: str, num_threads: int):
+    if backend == "python":
+        return PythonAligner()
+    if backend in ("native", "cpu"):
+        return NativeAligner(num_threads)
+    if backend == "tpu":
+        try:
+            from ..ops.nw import TpuAligner
+        except ImportError as e:
+            raise ValueError(f"TPU aligner backend unavailable: {e}")
+        return TpuAligner(fallback=NativeAligner(num_threads)
+                          if native.available() else PythonAligner())
+    if backend == "auto":
+        if native.available():
+            return NativeAligner(num_threads)
+        return PythonAligner()
+    raise ValueError(f"unknown aligner backend {backend!r}")
+
+
+def make_consensus(backend: str, match: int, mismatch: int, gap: int,
+                   num_threads: int = 1):
+    if backend in ("cpu", "auto", "python"):
+        return CpuPoaConsensus(match, mismatch, gap, num_threads)
+    if backend == "tpu":
+        try:
+            from ..ops.poa import TpuPoaConsensus
+        except ImportError as e:
+            raise ValueError(f"TPU consensus backend unavailable: {e}")
+        return TpuPoaConsensus(match, mismatch, gap,
+                               fallback=CpuPoaConsensus(match, mismatch, gap,
+                                                        num_threads))
+    raise ValueError(f"unknown consensus backend {backend!r}")

@@ -250,15 +250,8 @@ class Overlap:
                 start_k = 1  # first base index within the run after last boundary
                 while w < len(window_ends) and window_ends[w] <= run_t + n:
                     e = window_ends[w]
-                    if e <= run_t:
-                        # boundary already behind the run (can happen only if
-                        # it was exactly at run start handled by previous ops)
-                        if found_first:
-                            bp.append(first)
-                            bp.append(last)
-                        found_first = False
-                        w += 1
-                        continue
+                    # invariant: earlier runs consumed all boundaries <= t_ptr
+                    assert e > run_t, "boundary behind current run"
                     k = e - run_t  # base count consumed to reach boundary
                     if not found_first:
                         first = (run_t + start_k, run_q + start_k)
@@ -274,9 +267,6 @@ class Overlap:
                         found_first = True
                         first = (run_t + start_k, run_q + start_k)
                     last = (run_t + n + 1, run_q + n + 1)
-                elif found_first:
-                    # run fully consumed by boundaries; nothing pending
-                    pass
                 q_ptr += n
                 t_ptr += n
             elif op == "I":

@@ -1,0 +1,313 @@
+"""Polisher: the two-phase pipeline driver (initialize -> polish).
+
+Behavioural spec from the reference's ``src/polisher.cpp``:
+
+- factory validates extensions then builds the CPU or accelerated pipeline
+  (``polisher.cpp:55-159``);
+- ``initialize()`` (``polisher.cpp:191-459``): load targets, load reads with
+  name-dedup against targets, NGS/TGS window-type heuristic (mean read length
+  <= 1000 -> NGS), load + transmute overlaps with streaming per-query
+  filtering (error > threshold, self-overlaps, best-per-query for contig
+  polishing), lazy reverse-complement materialization, breaking-point
+  alignment, window construction and layer assignment (min-span 2% of window
+  length, mean PHRED quality >= threshold);
+- ``polish()`` (``polisher.cpp:485-547``): per-window consensus via the
+  backend, stitch per target, emit ``LN:i/RC:i/XC:f`` tags.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..io import parsers
+from ..utils.logger import Logger
+from .backends import make_aligner, make_consensus
+from .overlap import Overlap
+from .sequence import Sequence
+from .window import Window, WindowType
+
+
+class PolisherType(enum.Enum):
+    C = 0  # contig polishing
+    F = 1  # fragment (read) error correction
+
+
+def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
+                    type_: PolisherType = PolisherType.C,
+                    window_length: int = 500, quality_threshold: float = 10.0,
+                    error_threshold: float = 0.3, trim: bool = True,
+                    match: int = 3, mismatch: int = -5, gap: int = -4,
+                    num_threads: int = 1, aligner_backend: str = "auto",
+                    consensus_backend: str = "auto") -> "Polisher":
+    """Factory with the reference's validation rules
+    (``polisher.cpp:62-133``)."""
+    if not isinstance(type_, PolisherType):
+        raise ValueError("invalid polisher type")
+    if window_length <= 0:
+        raise ValueError("invalid window length")
+    for path, kind in ((sequences_path, "sequences"), (target_path, "target")):
+        if parsers.sequence_parser_for(path) is None:
+            raise ValueError(
+                f"file {path} has unsupported format extension (valid: "
+                f"{', '.join(parsers.SEQUENCE_EXTENSIONS)})")
+    if parsers.overlap_parser_for(overlaps_path) is None:
+        raise ValueError(
+            f"file {overlaps_path} has unsupported format extension (valid: "
+            f"{', '.join(parsers.OVERLAP_EXTENSIONS)})")
+    return Polisher(sequences_path, overlaps_path, target_path, type_,
+                    window_length, quality_threshold, error_threshold, trim,
+                    match, mismatch, gap, num_threads, aligner_backend,
+                    consensus_backend)
+
+
+class Polisher:
+    def __init__(self, sequences_path, overlaps_path, target_path, type_,
+                 window_length, quality_threshold, error_threshold, trim,
+                 match, mismatch, gap, num_threads,
+                 aligner_backend="auto", consensus_backend="auto"):
+        self.sequences_path = sequences_path
+        self.overlaps_path = overlaps_path
+        self.target_path = target_path
+        self.type = type_
+        self.window_length = window_length
+        self.quality_threshold = quality_threshold
+        self.error_threshold = error_threshold
+        self.trim = trim
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.num_threads = num_threads
+        self.aligner = make_aligner(aligner_backend, num_threads)
+        self.consensus = make_consensus(consensus_backend, match, mismatch,
+                                        gap, num_threads)
+        self.logger = Logger()
+
+        self.sequences: List[Sequence] = []
+        self.windows: List[Window] = []
+        self.targets_size = 0
+        self.targets_coverages: List[int] = []
+        self._window_type = WindowType.TGS
+        self._dummy_quality = b"!" * window_length
+
+    # ---------------------------------------------------------- initialize
+
+    def initialize(self) -> None:
+        if self.windows:
+            print("[racon_tpu::Polisher::initialize] warning: "
+                  "object already initialized!")
+            return
+        log = self.logger
+        log.log()
+
+        tparse = parsers.sequence_parser_for(self.target_path)
+        self.sequences = [Sequence(r.name, r.data, r.quality)
+                          for r in tparse(self.target_path)]
+        self.targets_size = len(self.sequences)
+        if self.targets_size == 0:
+            raise ValueError("empty target sequences set")
+
+        name_to_id: Dict[bytes, int] = {}
+        id_to_id: Dict[int, int] = {}
+        for i, seq in enumerate(self.sequences):
+            name_to_id[seq.name + b"t"] = i
+            id_to_id[i << 1 | 1] = i
+
+        has_name = [True] * self.targets_size
+        has_data = [True] * self.targets_size
+        has_reverse = [False] * self.targets_size
+
+        log.log("[racon_tpu::Polisher::initialize] loaded target sequences")
+        log.log()
+
+        sparse = parsers.sequence_parser_for(self.sequences_path)
+        raw_index = 0
+        total_len = 0
+        for rec in sparse(self.sequences_path):
+            seq = Sequence(rec.name, rec.data, rec.quality)
+            total_len += len(seq.data)
+            tkey = seq.name + b"t"
+            tid = name_to_id.get(tkey)
+            if tid is not None:
+                existing = self.sequences[tid]
+                if (len(seq.data) != len(existing.data) or
+                        len(seq.quality or b"") != len(existing.quality or b"")):
+                    raise ValueError(
+                        f"duplicate sequence {seq.name!r} with unequal data")
+                name_to_id[seq.name + b"q"] = tid
+                id_to_id[raw_index << 1 | 0] = tid
+            else:
+                self.sequences.append(seq)
+                pos = len(self.sequences) - 1
+                name_to_id[seq.name + b"q"] = pos
+                id_to_id[raw_index << 1 | 0] = pos
+                has_name.append(False)
+                has_data.append(False)
+                has_reverse.append(False)
+            raw_index += 1
+
+        if raw_index == 0:
+            raise ValueError("empty sequences set")
+
+        self._window_type = (WindowType.NGS
+                             if total_len / raw_index <= 1000
+                             else WindowType.TGS)
+
+        log.log("[racon_tpu::Polisher::initialize] loaded sequences")
+        log.log()
+
+        oparse = parsers.overlap_parser_for(self.overlaps_path)
+        overlaps: List[Optional[Overlap]] = []
+        for rec in oparse(self.overlaps_path):
+            o = Overlap.from_record(rec)
+            o.transmute(self.sequences, name_to_id, id_to_id)
+            if o.is_valid:
+                overlaps.append(o)
+
+        overlaps = self._filter_overlaps(overlaps)
+        if not overlaps:
+            raise ValueError("empty overlap set")
+
+        for o in overlaps:
+            if o.strand:
+                has_reverse[o.q_id] = True
+            else:
+                has_data[o.q_id] = True
+
+        log.log("[racon_tpu::Polisher::initialize] loaded overlaps")
+        log.log()
+
+        for i, seq in enumerate(self.sequences):
+            seq.transmute(has_name[i], has_data[i], has_reverse[i])
+
+        self.find_overlap_breaking_points(overlaps)
+        log.log()
+
+        self._build_windows(overlaps)
+        log.log("[racon_tpu::Polisher::initialize] transformed data into windows")
+
+    def _filter_overlaps(self, overlaps: List[Overlap]) -> List[Overlap]:
+        """Per-query group filter (``polisher.cpp:283-307``): drop
+        error > threshold and self overlaps; for contig polishing keep only
+        the longest overlap per consecutive same-query group (the later
+        overlap wins length ties, matching the reference's pairwise sweep)."""
+        result: List[Overlap] = []
+        i = 0
+        while i < len(overlaps):
+            j = i
+            while j < len(overlaps) and overlaps[j].q_id == overlaps[i].q_id:
+                j += 1
+            group = [o for o in overlaps[i:j]
+                     if o.error <= self.error_threshold and o.q_id != o.t_id]
+            if group and self.type == PolisherType.C:
+                best = group[0]
+                for o in group[1:]:
+                    if o.length >= best.length:
+                        best = o
+                group = [best]
+            result.extend(group)
+            i = j
+        return result
+
+    def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
+        """Align CIGAR-less overlaps (batched through the aligner backend —
+        reference: ``polisher.cpp:461-483`` / ``cudapolisher.cpp:86-200``)
+        then derive per-window breaking points."""
+        need = [o for o in overlaps if not o.cigar and not o.breaking_points]
+        # Feed the aligner in bounded chunks so transient span copies stay
+        # O(chunk) rather than O(total reads) (reference analog: 1 GiB
+        # streaming chunks, polisher.cpp:26).
+        chunk = 1024
+        for begin in range(0, len(need), chunk):
+            part = need[begin:begin + chunk]
+            pairs = [(o.query_span_bytes(self.sequences),
+                      o.target_span_bytes(self.sequences)) for o in part]
+            cigars = self.aligner.align_batch(pairs)
+            for o, cigar in zip(part, cigars):
+                o.cigar = cigar
+        for o in overlaps:
+            o.find_breaking_points(self.sequences, self.window_length)
+        self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
+
+    def _build_windows(self, overlaps: List[Overlap]) -> None:
+        window_length = self.window_length
+        id_to_first_window = [0] * (self.targets_size + 1)
+        for i in range(self.targets_size):
+            target = self.sequences[i]
+            data = target.data
+            k = 0
+            for j in range(0, len(data), window_length):
+                length = min(j + window_length, len(data)) - j
+                quality = (self._dummy_quality[:length]
+                           if target.quality is None
+                           else target.quality[j:j + length])
+                self.windows.append(Window(i, k, self._window_type,
+                                           data[j:j + length], quality))
+                k += 1
+            id_to_first_window[i + 1] = id_to_first_window[i] + k
+
+        self.targets_coverages = [0] * self.targets_size
+
+        min_span = 0.02 * window_length
+        for o in overlaps:
+            self.targets_coverages[o.t_id] += 1
+            seq = self.sequences[o.q_id]
+            bp = o.breaking_points
+            data_all = seq.reverse_complement if o.strand else seq.data
+            qual_all = seq.reverse_quality if o.strand else seq.quality
+            qual_arr = (np.frombuffer(qual_all, dtype=np.uint8)
+                        if qual_all else None)
+            for j in range(0, len(bp), 2):
+                q_begin, q_end = bp[j][1], bp[j + 1][1]
+                if q_end - q_begin < min_span:
+                    continue
+                if qual_arr is not None:
+                    avg = float(qual_arr[q_begin:q_end].mean()) - 33.0
+                    if avg < self.quality_threshold:
+                        continue
+                window_rank = bp[j][0] // window_length
+                window_id = id_to_first_window[o.t_id] + window_rank
+                window_start = window_rank * window_length
+                data = data_all[q_begin:q_end]
+                quality = (qual_all[q_begin:q_end]
+                           if qual_all is not None else None)
+                self.windows[window_id].add_layer(
+                    data, quality,
+                    bp[j][0] - window_start,
+                    bp[j + 1][0] - window_start - 1)
+            o.breaking_points = []
+
+    # -------------------------------------------------------------- polish
+
+    def polish(self, drop_unpolished_sequences: bool = True) -> List[Sequence]:
+        log = self.logger
+        log.log()
+
+        polished_flags = self.consensus.run(self.windows, self.trim)
+
+        dst: List[Sequence] = []
+        polished_data: List[bytes] = []
+        num_polished = 0
+        for i, window in enumerate(self.windows):
+            num_polished += 1 if polished_flags[i] else 0
+            polished_data.append(window.consensus)
+
+            last = (i == len(self.windows) - 1 or
+                    self.windows[i + 1].rank == 0)
+            if last:
+                ratio = num_polished / float(window.rank + 1)
+                if not drop_unpolished_sequences or ratio > 0:
+                    data = b"".join(polished_data)
+                    tags = b"r" if self.type == PolisherType.F else b""
+                    tags += b" LN:i:%d" % len(data)
+                    tags += b" RC:i:%d" % self.targets_coverages[window.id]
+                    tags += b" XC:f:%.6f" % ratio
+                    dst.append(Sequence(
+                        self.sequences[window.id].name + tags, data))
+                num_polished = 0
+                polished_data = []
+
+        log.log("[racon_tpu::Polisher::polish] generated consensus")
+        self.windows = []
+        self.sequences = []
+        return dst

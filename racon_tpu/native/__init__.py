@@ -1,0 +1,125 @@
+"""Native host core: C++ aligner (and later POA) loaded via ctypes.
+
+Built on demand with g++ (no pip/pybind11 dependency); the shared object is
+cached next to the sources and rebuilt when any .cpp is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+
+_DIR = pathlib.Path(__file__).resolve().parent
+_LIB_PATH = _DIR / "libracon_native.so"
+_SOURCES = sorted(_DIR.glob("*.cpp"))
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _needs_build() -> bool:
+    if not _LIB_PATH.exists():
+        return True
+    lib_mtime = _LIB_PATH.stat().st_mtime
+    return any(src.stat().st_mtime > lib_mtime for src in _SOURCES)
+
+
+def build(force: bool = False) -> pathlib.Path:
+    """Compile the native library if needed. Returns its path."""
+    with _lock:
+        if force or _needs_build():
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                "-march=native", "-pthread",
+                *[str(s) for s in _SOURCES],
+                "-o", str(_LIB_PATH),
+            ]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native build failed:\n{proc.stderr[-4000:]}")
+    return _LIB_PATH
+
+
+def load():
+    """Load (building if necessary) and return the ctypes library handle,
+    or None when no C++ toolchain is available."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+    try:
+        build()
+    except (NativeBuildError, FileNotFoundError):
+        return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.rt_nw_cigar.restype = ctypes.c_void_p
+    lib.rt_nw_cigar.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                ctypes.c_char_p, ctypes.c_int64]
+    lib.rt_edit_distance.restype = ctypes.c_int64
+    lib.rt_edit_distance.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_char_p, ctypes.c_int64]
+    lib.rt_nw_cigar_batch.restype = None
+    lib.rt_nw_cigar_batch.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p)]
+    lib.rt_free.restype = None
+    lib.rt_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def nw_cigar(q: bytes, t: bytes) -> str:
+    """Global unit-cost alignment; returns CIGAR (M/I/D, I consumes query)."""
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    ptr = lib.rt_nw_cigar(q, len(q), t, len(t))
+    try:
+        return ctypes.string_at(ptr).decode()
+    finally:
+        lib.rt_free(ptr)
+
+
+def edit_distance(a: bytes, b: bytes) -> int:
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    return lib.rt_edit_distance(a, len(a), b, len(b))
+
+
+def nw_cigar_batch(pairs, num_threads: int = 1) -> list:
+    """Align many (q, t) byte-string pairs in parallel (C++ thread pool,
+    dynamic work queue — the host analog of the reference's per-batch
+    fill/process loop at src/cuda/cudapolisher.cpp:98-160)."""
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    count = len(pairs)
+    if count == 0:
+        return []
+    qs = (ctypes.c_char_p * count)(*[q for q, _ in pairs])
+    ts = (ctypes.c_char_p * count)(*[t for _, t in pairs])
+    qns = (ctypes.c_int64 * count)(*[len(q) for q, _ in pairs])
+    tns = (ctypes.c_int64 * count)(*[len(t) for _, t in pairs])
+    outs = (ctypes.c_void_p * count)()
+    lib.rt_nw_cigar_batch(count, qs, qns, ts, tns, num_threads, outs)
+    result = []
+    for i in range(count):
+        result.append(ctypes.string_at(outs[i]).decode())
+        lib.rt_free(outs[i])
+    return result
