@@ -1,0 +1,26 @@
+"""JAX/XLA/Pallas batched kernels — the TPU compute path.
+
+- ``racon_tpu.ops.nw``  — batched banded NW direction-matrix kernel + host
+  traceback (role of the reference's cudaaligner batches,
+  ``src/cuda/cudaaligner.cpp``).
+- ``racon_tpu.ops.poa`` — batched POA consensus kernel (role of cudapoa,
+  ``src/cuda/cudabatch.cpp``).
+"""
+
+import os as _os
+
+import jax as _jax
+
+# Persist XLA compilations across processes: the kernels are recompiled per
+# (bucket shape x batch size) and a CLI/test run pays tens of seconds of
+# compile time otherwise. Opt out with RACON_TPU_NO_COMPILE_CACHE=1.
+if not _os.environ.get("RACON_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = _os.environ.get(
+        "RACON_TPU_COMPILE_CACHE",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "racon_tpu_xla"))
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # cache is an optimization, never fatal
+        pass

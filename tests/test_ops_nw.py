@@ -1,0 +1,78 @@
+"""Batched device aligner tests (run on the CPU XLA backend via conftest;
+the same code path runs on TPU — see .claude/skills/verify/SKILL.md)."""
+
+import random
+
+import pytest
+
+from racon_tpu.core.backends import NativeAligner, PythonAligner
+from racon_tpu.models.nw import edit_distance
+from racon_tpu.ops.nw import TpuAligner, BUCKETS
+from tests.test_nw import cigar_cost, cigar_consumes
+
+
+def mutate(rng, s, err):
+    out = bytearray()
+    for ch in s:
+        r = rng.random()
+        if r < err * 0.4:
+            out.append(rng.choice(b"ACGT"))
+        elif r < err * 0.7:
+            pass
+        elif r < err:
+            out.extend([ch, rng.choice(b"ACGT")])
+        else:
+            out.append(ch)
+    return bytes(out)
+
+
+@pytest.fixture(scope="module")
+def aligner():
+    try:
+        fb = NativeAligner(1)
+    except RuntimeError:
+        fb = PythonAligner()
+    return TpuAligner(fallback=fb)
+
+
+def test_device_alignments_optimal(aligner):
+    rng = random.Random(11)
+    pairs = []
+    for L, err in [(60, 0.2), (200, 0.15), (900, 0.15), (2000, 0.12),
+                   (300, 0.3), (500, 0.02), (100, 0.0)]:
+        a = bytes(rng.choice(b"ACGT") for _ in range(L))
+        pairs.append((mutate(rng, a, err), a))
+    cigars = aligner.align_batch(pairs)
+    for (q, t), cig in zip(pairs, cigars):
+        assert cigar_consumes(cig) == (len(q), len(t))
+        assert cigar_cost(cig, q, t) == edit_distance(q, t)
+
+
+def test_length_mismatch_and_empty(aligner):
+    rng = random.Random(12)
+    a = bytes(rng.choice(b"ACGT") for _ in range(400))
+    pairs = [(a, a[:200]), (a[:150], a), (b"", a[:30]), (a[:30], b"")]
+    cigars = aligner.align_batch(pairs)
+    for (q, t), cig in zip(pairs, cigars):
+        assert cigar_consumes(cig) == (len(q), len(t))
+    assert cigars[2] == "30D"
+    assert cigars[3] == "30I"
+
+
+def test_band_escalation_handles_high_divergence(aligner):
+    rng = random.Random(13)
+    a = bytes(rng.choice(b"ACGT") for _ in range(1500))
+    b = mutate(rng, a, 0.45)  # extreme divergence forces band escalation
+    (cig,) = aligner.align_batch([(b, a)])
+    assert cigar_consumes(cig) == (len(b), len(a))
+    assert cigar_cost(cig, b, a) == edit_distance(b, a)
+
+
+def test_oversize_pair_falls_back(aligner):
+    max_len = max(m for m, _ in BUCKETS)
+    rng = random.Random(14)
+    a = bytes(rng.choice(b"ACGT") for _ in range(max_len + 10))
+    before = dict(aligner.stats)
+    (cig,) = aligner.align_batch([(a, a)])
+    assert cig == f"{len(a)}M"
+    assert aligner.stats["fallback_length"] == before["fallback_length"] + 1
