@@ -34,8 +34,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .. import native
-
 # (max query length, band width). Band covers error rates up to ~W/(2L).
 BUCKETS: Tuple[Tuple[int, int], ...] = (
     (256, 128),
@@ -144,6 +142,26 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int):
     return jax.vmap(per_pair)(qrp, tp, n, m)
 
 
+def _walk_op(pk, i, j, *, c, RB, S, U):
+    """Shared one-step decode of the packed direction matrix during a
+    backward walk from (i, j). Returns (op, di, dj): op 0=M, 1=I, 2=D,
+    3=done-or-stalled (band escape stalls so final (i,j) != 0 flags it)."""
+    a = i + j
+    p = (a + c) & 1
+    u = (j - i + c - p) // 2
+    pos = (a - 1) * RB + u // 4
+    byte = jnp.take(pk, jnp.clip(pos, 0, S * RB - 1))
+    d = ((byte >> (2 * (u % 4).astype(jnp.uint8))) & 3).astype(jnp.uint8)
+    d = jnp.where(i == 0, jnp.uint8(2), d)              # only D left
+    d = jnp.where((j == 0) & (i > 0), jnp.uint8(1), d)  # only I left
+    escaped = (i > 0) & (j > 0) & ((u < 0) | (u >= U))
+    done = ((i == 0) & (j == 0)) | escaped
+    op = jnp.where(done, jnp.uint8(3), d)
+    di = jnp.where((op == 0) | (op == 1), 1, 0)
+    dj = jnp.where((op == 0) | (op == 2), 1, 0)
+    return op, di, dj
+
+
 @functools.partial(jax.jit, static_argnames=("max_len", "band"))
 def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
     """On-device traceback: vmapped pointer chase over the packed direction
@@ -164,20 +182,7 @@ def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
     def per_pair(pk, nn, mm):
         def step(carry, _):
             i, j = carry
-            a = i + j
-            p = (a + c) & 1
-            u = (j - i + c - p) // 2
-            pos = (a - 1) * RB + u // 4
-            byte = jnp.take(pk, jnp.clip(pos, 0, 2 * L * RB - 1))
-            d = ((byte >> (2 * (u % 4).astype(jnp.uint8))) & 3).astype(jnp.uint8)
-            d = jnp.where(i == 0, jnp.uint8(2), d)            # only D left
-            d = jnp.where((j == 0) & (i > 0), jnp.uint8(1), d)  # only I left
-            # band escape: stall (emits 3) so the final (i, j) != 0 flags it
-            escaped = (i > 0) & (j > 0) & ((u < 0) | (u >= U))
-            done = ((i == 0) & (j == 0)) | escaped
-            op = jnp.where(done, jnp.uint8(3), d)
-            di = jnp.where((op == 0) | (op == 1), 1, 0)
-            dj = jnp.where((op == 0) | (op == 2), 1, 0)
+            op, di, dj = _walk_op(pk, i, j, c=c, RB=RB, S=2 * L, U=U)
             return (i - di, j - dj), op
 
         (fi, fj), ops = lax.scan(step, (nn, mm), None, length=2 * L)

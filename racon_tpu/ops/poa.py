@@ -1,0 +1,384 @@
+"""Batched window consensus on TPU (cudapoa-equivalent).
+
+Role: the accelerated consensus engine behind ``Polisher.polish`` — one
+device batch processes (windows x layers) at once, the analog of a cudapoa
+``Batch`` of POA groups (``src/cuda/cudabatch.cpp:54-62``).
+
+Design (TPU-first): instead of porting cudapoa's irregular
+one-block-per-group graph POA, consensus is computed as a
+**quality-weighted pileup**:
+
+1. every layer is globally aligned to its backbone span with the wavefront
+   NW kernel from ``ops.nw`` (all windows' layers in one fixed-shape batch —
+   thousands of concurrent alignments, the shape TPUs like);
+2. a traceback variant walks each alignment on device and scatter-adds
+   weighted votes (A/C/G/T/N/deletion per backbone column, plus K insertion
+   slots per junction) into per-window count matrices;
+3. consensus = per-column argmax over weighted votes (insertion slots emit
+   when they out-weigh half the column totals), with per-base unweighted
+   coverage for the reference's TGS end-trimming contract
+   (``src/window.cpp:118-139``).
+
+Like the reference's GPU path, this engine is allowed to differ slightly
+from the CPU spoa-semantics engine (upstream records separate CUDA goldens:
+1385 vs CPU 1312, ``test/racon_test.cpp:312``); windows the device cannot
+handle (oversize backbone/layers, depth, band escapes) fall back to the CPU
+engine, mirroring ``StatusType`` rejects (``src/cuda/cudabatch.cpp:135-156``).
+
+Known engine limitation (vs the CPU graph-POA): insertions occurring before
+the very first backbone column of a window (junction "-1") have no vote
+slot and are dropped; window stitching means only contig ends are affected.
+A faithful graph-POA device kernel is planned to close the remaining
+quality gap (recorded goldens: device 2656 vs CPU 1324 on λ-phage).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .nw import _nw_wavefront_kernel, _walk_op
+from ..core.window import WindowType
+
+# Alignment band for layer-vs-backbone-span alignment (layers are ~window
+# sized; c=256 covers ~50% divergence at 500 bp).
+BAND = 512
+# Insertion slots tracked per backbone junction.
+K_INS = 3
+# Vote channels: A C G T N DEL (stride 8 for cheap addressing).
+CH = 8
+A, C, G, T, N_CODE, DEL = 0, 1, 2, 3, 4, 5
+
+_CODE_LUT = np.full(256, N_CODE, dtype=np.uint8)
+for i, b in enumerate(b"ACGT"):
+    _CODE_LUT[b] = i
+_BYTE_LUT = np.frombuffer(b"ACGTN-", dtype=np.uint8)
+
+MAX_PAIR_DIRS_BYTES = 1024 * 1024 * 1024
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_len", "band", "L", "K", "n_windows"))
+def _vote_kernel(packed, score, n, m, qcodes, qweights, begin, win_of,
+                 *, n_windows: int, max_len: int, band: int, L: int, K: int):
+    """Walk every alignment backwards on device and scatter weighted votes.
+
+    packed: uint8 [B, 2*max_len, band/8] direction matrix (from the NW
+    kernel); qcodes/qweights: [B, max_len] layer base codes and weights;
+    begin: [B] backbone-span start column; win_of: [B] owning window index.
+
+    Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted same-shape
+    i32, ok [B] bool). Vote layout: column votes at col*CH+ch, insertion
+    slot s of junction col at (L + col*K + s)*CH + ch.
+    """
+    W = band
+    c = W // 2
+    Lq = max_len
+    RB = W // 8
+    B = packed.shape[0]
+    S = 2 * Lq
+    VOT = L * (1 + K) * CH
+    flat = packed.reshape(B, S * RB)
+
+    def per_pair(pk, nn, mm, qc, qw, bg):
+        def step(carry, _):
+            i, j, ins_run = carry
+            op, di, dj = _walk_op(pk, i, j, c=c, RB=RB, S=S, U=W // 2)
+            op = op.astype(jnp.int32)
+
+            base = jnp.take(qc, jnp.clip(i - 1, 0, Lq - 1)).astype(jnp.int32)
+            wgt = jnp.take(qw, jnp.clip(i - 1, 0, Lq - 1)).astype(jnp.float32)
+            col = bg + j - 1
+            # vote target: M -> (col, base); D -> (col, DEL); I -> ins slot
+            slot = jnp.minimum(ins_run, K - 1)
+            idx = jnp.where(
+                op == 0, col * CH + base,
+                jnp.where(op == 2, col * CH + DEL,
+                          (L + col * K + slot) * CH + base))
+            valid = (op < 3) & (j >= 1) & (col >= 0) & (col < L)
+            idx = jnp.where(valid, idx, VOT)  # sink
+            w = jnp.where(valid, wgt, 0.0)
+
+            ins_run = jnp.where(op == 1, ins_run + 1, 0)
+            return (i - di, j - dj, ins_run), (idx, w)
+
+        (fi, fj, _), (idxs, ws) = lax.scan(
+            step, (nn, mm, jnp.int32(0)), None, length=S)
+        ok = (fi == 0) & (fj == 0)
+        return idxs, ws, ok
+
+    idxs, ws, ok = jax.vmap(per_pair)(flat, n, m, qcodes, qweights, begin)
+    ok = ok & (score < (band // 2))
+    wsv = ws * ok[:, None].astype(jnp.float32)
+
+    flat_idx = (win_of[:, None] * (VOT + 1) + idxs).reshape(-1)
+    weighted = jnp.zeros(n_windows * (VOT + 1), jnp.float32)
+    weighted = weighted.at[flat_idx].add(wsv.reshape(-1))
+    unweighted = jnp.zeros(n_windows * (VOT + 1), jnp.int32)
+    unweighted = unweighted.at[flat_idx].add(
+        (wsv.reshape(-1) > 0).astype(jnp.int32))
+    weighted = weighted.reshape(n_windows, VOT + 1)[:, :VOT]
+    unweighted = unweighted.reshape(n_windows, VOT + 1)[:, :VOT]
+    return weighted, unweighted, ok
+
+
+@functools.partial(jax.jit, static_argnames=("L", "K"))
+def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
+                      *, L: int, K: int):
+    """Add backbone votes, then pick per-column and insertion winners."""
+    n_windows = weighted.shape[0]
+    cols = jnp.arange(L)
+
+    w = weighted.reshape(n_windows, L * (1 + K), CH)
+    uw = unweighted.reshape(n_windows, L * (1 + K), CH)
+    col_votes = w[:, :L, :]      # [n, L, CH]
+    ins_votes = w[:, L:, :].reshape(n_windows, L, K, CH)
+    col_unw = uw[:, :L, :]
+    ins_unw = uw[:, L:, :].reshape(n_windows, L, K, CH)
+
+    # backbone's own votes (weight may be 0 for dummy quality -> still
+    # contributes 1 to unweighted coverage, like a spoa sequence label)
+    in_range = cols[None, :] < blen[:, None]
+    bb_onehot = jax.nn.one_hot(bcodes, CH, dtype=jnp.float32)
+    eps_w = jnp.maximum(bweights, 0.01)  # dummy-quality backbones still win
+                                         # columns with no layer votes
+    col_votes = col_votes + bb_onehot * (eps_w * in_range)[..., None]
+    col_unw = col_unw + (bb_onehot * in_range[..., None]).astype(jnp.int32)
+
+    winner = jnp.argmax(col_votes[:, :, :DEL + 1], axis=-1)  # [n, L]
+    win_w = jnp.take_along_axis(col_votes, winner[..., None], -1)[..., 0]
+    coverage = jnp.take_along_axis(col_unw, winner[..., None], -1)[..., 0]
+    col_total = col_votes.sum(-1)
+
+    ins_winner = jnp.argmax(ins_votes[:, :, :, :N_CODE + 1], axis=-1)
+    ins_w = jnp.take_along_axis(ins_votes, ins_winner[..., None], -1)[..., 0]
+    ins_cov = jnp.take_along_axis(ins_unw, ins_winner[..., None], -1)[..., 0]
+    # an insertion is emitted when its weight beats half the column total
+    ins_emit = ins_w > 0.5 * col_total[:, :, None]
+
+    return winner, coverage, ins_winner, ins_emit, ins_cov
+
+
+class _Work:
+    """Mutable per-window state across refinement rounds."""
+
+    __slots__ = ("win", "backbone", "bqual", "layers", "n_seqs", "covs")
+
+    def __init__(self, win, max_depth, stats):
+        self.win = win
+        self.backbone = win.sequences[0]
+        self.bqual = win.qualities[0]
+        self.layers = []  # (seq, qual, begin, end)
+        depth = min(len(win.sequences) - 1, max_depth)
+        stats["dropped_layers"] += max(0, len(win.sequences) - 1 - max_depth)
+        for li in range(1, depth + 1):
+            b, e = win.positions[li]
+            self.layers.append((win.sequences[li], win.qualities[li], b, e))
+        self.n_seqs = len(win.sequences)
+        self.covs = None
+
+
+class TpuPoaConsensus:
+    """Batched device consensus with CPU fallback for rejects.
+
+    ``rounds`` controls iterative refinement: round r re-aligns every layer
+    against the round r-1 consensus (with layer spans remapped through the
+    emitted-column map), which recovers most of the gap between one-shot
+    pileup voting and graph POA.
+    """
+
+    def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
+                 max_depth: int = 200, band: int = BAND, rounds: int = 3):
+        # match/mismatch/gap kept for interface parity; the pileup engine
+        # votes by base weight rather than alignment score.
+        self.fallback = fallback
+        self.max_depth = max_depth
+        self.band = band
+        self.rounds = rounds
+        self.stats = {"device_windows": 0, "fallback_windows": 0,
+                      "dropped_layers": 0, "passthrough": 0}
+
+    # -------------------------------------------------------------- public
+
+    def run(self, windows, trim: bool) -> List[bool]:
+        results: List[Optional[bool]] = [None] * len(windows)
+        works: List[_Work] = []
+        for i, win in enumerate(windows):
+            if len(win.sequences) < 3:
+                win.consensus = win.sequences[0]
+                results[i] = False
+                self.stats["passthrough"] += 1
+            else:
+                works.append((i, _Work(win, self.max_depth, self.stats)))
+
+        live = [(i, w) for i, w in works if len(w.layers) >= 2]
+        for i, w in works:
+            if len(w.layers) < 2:
+                results[i] = None  # CPU fallback
+
+        for rnd in range(self.rounds):
+            if not live:
+                break
+            max_bb = max(len(w.backbone) for _, w in live)
+            L = max(256, -(-max_bb // 256) * 256)
+            Lq = L + self.band
+            fit, rejected = [], []
+            for i, w in live:
+                if all(len(s) <= Lq for s, _, _, _ in w.layers):
+                    fit.append((i, w))
+                else:
+                    rejected.append(i)
+            live = fit
+            if not live:
+                break
+            self._device_round(live, L, Lq)
+
+        for i, w in live:
+            covs = w.covs
+            consensus = w.backbone
+            if covs is None:  # no successful device round
+                results[i] = None
+                continue
+            if w.win.type == WindowType.TGS and trim:
+                avg_cov = (w.n_seqs - 1) // 2
+                b_, e_ = 0, len(consensus) - 1
+                while b_ < len(consensus) and covs[b_] < avg_cov:
+                    b_ += 1
+                while e_ >= 0 and covs[e_] < avg_cov:
+                    e_ -= 1
+                if b_ < e_:
+                    consensus = consensus[b_:e_ + 1]
+            w.win.consensus = consensus
+            results[i] = True
+            self.stats["device_windows"] += 1
+
+        cpu_idx = [i for i, r in enumerate(results) if r is None]
+        if cpu_idx:
+            self.stats["fallback_windows"] += len(cpu_idx)
+            if self.fallback is None:
+                raise RuntimeError(
+                    f"{len(cpu_idx)} windows rejected, no CPU fallback")
+            flags = self.fallback.run([windows[i] for i in cpu_idx], trim)
+            for i, f in zip(cpu_idx, flags):
+                results[i] = f
+        return [bool(r) for r in results]
+
+    # -------------------------------------------------------------- device
+
+    def _device_round(self, live, L, Lq) -> None:
+        """One align+vote+consensus pass; updates each _Work in place."""
+        band = self.band
+        c = band // 2
+        width = c + Lq + band
+
+        pair_entries = []  # (local window ordinal, layer index)
+        for wi, (_, w) in enumerate(live):
+            for li in range(len(w.layers)):
+                pair_entries.append((wi, li))
+
+        nW = len(live)
+        nP = len(pair_entries)
+        B = 1
+        while B < nP:
+            B *= 2
+        nWp = 1
+        while nWp < nW + 1:
+            nWp *= 2
+
+        qrp = np.zeros((B, width), np.uint8)
+        tp = np.zeros((B, width), np.uint8)
+        n = np.ones(B, np.int32)
+        m = np.ones(B, np.int32)
+        qcodes = np.zeros((B, Lq), np.uint8)
+        qweights = np.zeros((B, Lq), np.float32)
+        begin = np.zeros(B, np.int32)
+        win_of = np.full(B, nWp - 1, np.int32)  # padding -> sink window
+
+        for k, (wi, li) in enumerate(pair_entries):
+            w = live[wi][1]
+            seq, qual, bg, ed = w.layers[li]
+            bb = w.backbone
+            bg = min(bg, len(bb) - 1)
+            ed = min(ed, len(bb) - 1)
+            span = bb[bg:ed + 1]
+            qrp[k, c + Lq - len(seq): c + Lq] = \
+                np.frombuffer(seq, np.uint8)[::-1]
+            tp[k, c: c + len(span)] = np.frombuffer(span, np.uint8)
+            n[k], m[k] = len(seq), len(span)
+            qcodes[k, :len(seq)] = _CODE_LUT[np.frombuffer(seq, np.uint8)]
+            if qual is not None:
+                qweights[k, :len(seq)] = \
+                    np.frombuffer(qual, np.uint8).astype(np.float32) - 33.0
+            else:
+                qweights[k, :len(seq)] = 1.0
+            begin[k] = bg
+            win_of[k] = wi
+
+        bcodes = np.zeros((nWp, L), np.uint8)
+        bweights = np.zeros((nWp, L), np.float32)
+        blen = np.zeros(nWp, np.int32)
+        for wi, (_, w) in enumerate(live):
+            bb = w.backbone
+            bcodes[wi, :len(bb)] = _CODE_LUT[np.frombuffer(bb, np.uint8)]
+            if w.bqual is not None:
+                bweights[wi, :len(bb)] = \
+                    np.frombuffer(w.bqual, np.uint8).astype(np.float32) - 33.0
+            blen[wi] = len(bb)
+
+        packed, score = _nw_wavefront_kernel(
+            jnp.asarray(qrp), jnp.asarray(tp), jnp.asarray(n), jnp.asarray(m),
+            max_len=Lq, band=band)
+        weighted, unweighted, ok = _vote_kernel(
+            packed, score, jnp.asarray(n), jnp.asarray(m),
+            jnp.asarray(qcodes), jnp.asarray(qweights), jnp.asarray(begin),
+            jnp.asarray(win_of), n_windows=nWp,
+            max_len=Lq, band=band, L=L, K=K_INS)
+        out = _consensus_kernel(weighted, unweighted,
+                                jnp.asarray(bcodes), jnp.asarray(bweights),
+                                jnp.asarray(blen), L=L, K=K_INS)
+        winner, coverage, ins_winner, ins_emit, ins_cov = (
+            np.asarray(x) for x in jax.device_get(out))
+        ok = np.asarray(jax.device_get(ok))
+        self.stats["dropped_layers"] += int((~ok[:nP]).sum())
+
+        for wi, (_, w) in enumerate(live):
+            blen_i = len(w.backbone)
+            out_bytes = bytearray()
+            covs: List[int] = []
+            # emitted-column map for layer-span remapping in later rounds
+            col_to_new = np.zeros(blen_i + 1, np.int32)
+            for col in range(blen_i):
+                col_to_new[col] = len(out_bytes)
+                ch = int(winner[wi, col])
+                if ch <= N_CODE:
+                    out_bytes.append(_BYTE_LUT[ch])
+                    covs.append(int(coverage[wi, col]))
+                # slot s holds the s-th base from the END of an insertion
+                # run (the walk is backwards), so emit high slots first
+                for s_ in range(K_INS - 1, -1, -1):
+                    if ins_emit[wi, col, s_]:
+                        out_bytes.append(
+                            _BYTE_LUT[int(ins_winner[wi, col, s_])])
+                        covs.append(int(ins_cov[wi, col, s_]))
+            col_to_new[blen_i] = len(out_bytes)
+
+            new_bb = bytes(out_bytes)
+            if len(new_bb) == 0:
+                continue  # degenerate; keep previous backbone/covs
+            new_layers = []
+            for seq, qual, bg, ed in w.layers:
+                nb = int(col_to_new[min(bg, blen_i)])
+                ne = max(nb + 1, int(col_to_new[min(ed + 1, blen_i)]) - 1)
+                nb = min(nb, len(new_bb) - 1)
+                ne = min(ne, len(new_bb) - 1)
+                new_layers.append((seq, qual, nb, ne))
+            w.backbone = new_bb
+            w.bqual = None  # refined consensus carries no phred quality
+            w.layers = new_layers
+            w.covs = covs

@@ -1,0 +1,108 @@
+"""Batched device consensus (TPU pileup engine) tests on the CPU XLA
+backend. Quality parity with the CPU POA engine is asserted loosely — like
+the reference, the accelerated engine records its own goldens
+(test/racon_test.cpp:312 vs :106)."""
+
+import random
+
+import pytest
+
+from racon_tpu.core.backends import CpuPoaConsensus
+from racon_tpu.core.window import Window, WindowType
+from racon_tpu.models.nw import edit_distance
+from racon_tpu.ops.poa import TpuPoaConsensus
+
+
+def mutate(rng, s, err):
+    out = bytearray()
+    for ch in s:
+        r = rng.random()
+        if r < err * 0.25:
+            out.append(rng.choice(b"ACGT"))
+        elif r < err * 0.6:
+            pass
+        elif r < err:
+            out.extend([ch, rng.choice(b"ACGT")])
+        else:
+            out.append(ch)
+    return bytes(out)
+
+
+def make_window(rng, truth, err=0.15, depth=25, backbone_err=0.13):
+    backbone = mutate(rng, truth, backbone_err)
+    L = len(backbone)
+    w = Window(0, 0, WindowType.TGS, backbone, b"!" * L)
+    for _ in range(depth):
+        if rng.random() < 0.3:
+            b = rng.randrange(0, L // 2)
+            e = rng.randrange(b + L // 4, L)
+        else:
+            b, e = 0, L - 1
+        tfrac = truth[int(b / L * len(truth)): int((e + 1) / L * len(truth))]
+        frac = mutate(rng, tfrac, err)
+        qual = bytes(33 + min(50, max(1, int(rng.gauss(12, 4))))
+                     for _ in frac)
+        w.add_layer(frac, qual, b, e)
+    return w, backbone
+
+
+def test_device_consensus_improves_backbone():
+    rng = random.Random(5)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(400))
+    w, backbone = make_window(rng, truth)
+    engine = TpuPoaConsensus(3, -5, -4, fallback=None)
+    flags = engine.run([w], trim=True)
+    assert flags == [True]
+    d_bb = edit_distance(backbone, truth)
+    d_cons = edit_distance(w.consensus, truth)
+    assert d_cons < 0.35 * d_bb
+    assert engine.stats["device_windows"] == 1
+
+
+def test_determinism():
+    rng = random.Random(6)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(300))
+    state = rng.getstate()
+    w1, _ = make_window(rng, truth)
+    rng.setstate(state)
+    w2, _ = make_window(rng, truth)
+    engine = TpuPoaConsensus(3, -5, -4, fallback=None)
+    engine.run([w1], trim=True)
+    engine.run([w2], trim=True)
+    assert w1.consensus == w2.consensus
+
+
+def test_passthrough_below_three_sequences():
+    w = Window(0, 0, WindowType.TGS, b"ACGTACGT", b"!" * 8)
+    w.add_layer(b"ACGTACGT", None, 0, 7)
+    engine = TpuPoaConsensus(3, -5, -4, fallback=None)
+    flags = engine.run([w], trim=True)
+    assert flags == [False]
+    assert w.consensus == b"ACGTACGT"
+    assert engine.stats["passthrough"] == 1
+
+
+def test_cpu_fallback_for_low_effective_depth():
+    # max_depth=1 leaves a single usable layer -> CPU fallback
+    rng = random.Random(7)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(200))
+    w, _ = make_window(rng, truth, depth=3)
+    engine = TpuPoaConsensus(3, -5, -4,
+                             fallback=CpuPoaConsensus(3, -5, -4), max_depth=1)
+    flags = engine.run([w], trim=True)
+    assert flags == [True]
+    assert engine.stats["fallback_windows"] == 1
+
+
+def test_mixed_batch_with_ngs_window():
+    rng = random.Random(8)
+    truth = bytes(rng.choice(b"ACGT") for _ in range(300))
+    w1, _ = make_window(rng, truth)
+    w2 = Window(1, 0, WindowType.NGS, truth, b"!" * len(truth))
+    for _ in range(5):
+        w2.add_layer(mutate(rng, truth, 0.02), None, 0, len(truth) - 1)
+    engine = TpuPoaConsensus(3, -5, -4, fallback=None)
+    flags = engine.run([w1, w2], trim=True)
+    assert flags == [True, True]
+    # NGS windows are never trimmed
+    assert edit_distance(w2.consensus, truth) <= 3
