@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark: POA consensus throughput (windows/sec) on the λ-phage set.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+``value`` is the TPU consensus engine's warm windows/sec over the real
+λ-phage polishing workload (1 contig, ~1160 windows of w=500 at ~30x);
+``vs_baseline`` is the speedup over the CPU spoa-equivalent engine on the
+same windows (the reference's own accelerated-vs-CPU framing — it publishes
+no absolute numbers, BASELINE.md). Extra diagnostic fields ride along in
+the same JSON object. Progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+DATA = "/root/reference/test/data"
+
+
+def log(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def build_windows():
+    """Parse λ-phage and build the window set (SAM input carries CIGARs, so
+    no alignment is needed here; the aligner is benched separately)."""
+    from racon_tpu.core.polisher import create_polisher
+
+    p = create_polisher(
+        f"{DATA}/sample_reads.fastq.gz", f"{DATA}/sample_overlaps.sam.gz",
+        f"{DATA}/sample_layout.fasta.gz", num_threads=8)
+    p.initialize()
+    return p.windows
+
+
+def bench_consensus(windows):
+    from racon_tpu.core.backends import CpuPoaConsensus
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    cpu = CpuPoaConsensus(3, -5, -4, num_threads=8)
+    tpu = TpuPoaConsensus(3, -5, -4, fallback=cpu)
+
+    log("TPU consensus: cold run (compiles)...")
+    t0 = time.perf_counter()
+    tpu.run(windows, trim=True)
+    cold = time.perf_counter() - t0
+    log(f"cold: {cold:.2f}s, stats={tpu.stats}")
+
+    log("TPU consensus: warm run...")
+    t0 = time.perf_counter()
+    tpu.run(windows, trim=True)
+    warm = time.perf_counter() - t0
+    log(f"warm: {warm:.2f}s")
+
+    log("CPU consensus baseline...")
+    t0 = time.perf_counter()
+    cpu.run(windows, trim=True)
+    cpu_t = time.perf_counter() - t0
+    log(f"cpu: {cpu_t:.2f}s")
+    return cold, warm, cpu_t, dict(tpu.stats)
+
+
+def bench_aligner():
+    """Device aligner throughput on a synthetic ONT-like batch (15%
+    divergence, read lengths 2-8 kbp), pairs/sec warm."""
+    import numpy as np
+    from racon_tpu.ops.nw import TpuAligner
+
+    rng = np.random.default_rng(11)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    pairs = []
+    for _ in range(256):
+        ln = int(rng.integers(2000, 8000))
+        t = bases[rng.integers(0, 4, ln)]
+        q = t.copy()
+        flips = rng.random(ln) < 0.15
+        q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        pairs.append((q.tobytes(), t.tobytes()))
+
+    aligner = TpuAligner()
+    log("TPU aligner: cold run (compiles)...")
+    t0 = time.perf_counter()
+    aligner.align_batch(pairs)
+    cold = time.perf_counter() - t0
+    log(f"cold: {cold:.2f}s, stats={aligner.stats}")
+    log("TPU aligner: warm run...")
+    t0 = time.perf_counter()
+    cigars = aligner.align_batch(pairs)
+    warm = time.perf_counter() - t0
+    bases_aligned = sum(len(q) for q, _ in pairs)
+    log(f"warm: {warm:.2f}s ({len(pairs) / warm:.1f} pairs/s)")
+    assert all(cigars)
+    return len(pairs) / warm, bases_aligned / warm, cold
+
+
+def main():
+    import jax
+    log(f"jax {jax.__version__}, devices: {jax.devices()}")
+
+    log("building λ-phage windows...")
+    t0 = time.perf_counter()
+    windows = build_windows()
+    log(f"{len(windows)} windows in {time.perf_counter() - t0:.2f}s")
+
+    cold, warm, cpu_t, stats = bench_consensus(windows)
+    aln_pairs_s, aln_bases_s, aln_cold = bench_aligner()
+
+    total_bases = sum(len(w.sequences[0]) for w in windows)
+    result = {
+        "metric": "poa_windows_per_sec",
+        "value": round(len(windows) / warm, 2),
+        "unit": "windows/s",
+        "vs_baseline": round(cpu_t / warm, 3),
+        "n_windows": len(windows),
+        "mbp_polished_per_sec": round(total_bases / warm / 1e6, 4),
+        "tpu_warm_s": round(warm, 3),
+        "tpu_cold_s": round(cold, 3),
+        "cpu_s": round(cpu_t, 3),
+        "consensus_stats": stats,
+        "aligner_pairs_per_sec": round(aln_pairs_s, 2),
+        "aligner_bases_per_sec": round(aln_bases_s, 1),
+        "aligner_cold_s": round(aln_cold, 3),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -196,6 +196,16 @@ def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
     return ops_packed, score, fi, fj
 
 
+def align_chain(qrp, tp, n, m, *, max_len: int, band: int):
+    """Wavefront NW + on-device traceback — the single source of truth for
+    the aligner's kernel wiring, wrapped unchanged by both the plain path
+    (``TpuAligner._run_chunk``) and the ``shard_map`` path
+    (``racon_tpu.parallel.sharded_align``)."""
+    packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
+                                         max_len=max_len, band=band)
+    return _traceback_kernel(packed, score, n, m, max_len=max_len, band=band)
+
+
 def _ops_to_cigar(ops: np.ndarray, path_len: int) -> str:
     """Run-length encode reversed device op codes into a CIGAR string."""
     arr = ops[:path_len][::-1]
@@ -209,15 +219,32 @@ def _ops_to_cigar(ops: np.ndarray, path_len: int) -> str:
 
 
 class TpuAligner:
-    """Batched device aligner with on-device traceback and host fallback."""
+    """Batched device aligner with on-device traceback and host fallback.
+
+    ``mesh``: optional 1-D :class:`jax.sharding.Mesh`; when given, every
+    device batch is split along its batch dimension over the mesh with
+    ``shard_map`` (multi-chip analog of the reference's per-GPU batch
+    binning, ``src/cuda/cudapolisher.cpp:163-171``).
+    """
 
     def __init__(self, fallback=None, buckets=BUCKETS,
-                 max_dirs_bytes=MAX_DIRS_BYTES):
+                 max_dirs_bytes=MAX_DIRS_BYTES, mesh=None):
         self.fallback = fallback
         self.buckets = buckets
         self.max_dirs_bytes = max_dirs_bytes
+        self.mesh = mesh
         self.stats = {"device": 0, "fallback_length": 0, "fallback_band": 0,
                       "band_escalated": 0}
+
+    def _pad_batch(self, count: int) -> int:
+        """Batch sizes are ``mesh_size * 2^k`` — always divisible by the
+        mesh (shard_map splits evenly) and geometric (compile-cache hits);
+        plain power of two without a mesh."""
+        from ..parallel import mesh_size
+        B = mesh_size(self.mesh)
+        while B < count:
+            B *= 2
+        return B
 
     def _bucket_index(self, qlen: int, tlen: int, start: int = 0):
         need = abs(qlen - tlen) + 16
@@ -255,13 +282,13 @@ class TpuAligner:
             bi = min(by_bucket)
             indices = by_bucket.pop(bi)
             max_len, band = self.buckets[bi]
-            batch_cap = self.max_dirs_bytes // (max_len * (band // 4))
-            # chunks are padded to a power of two (compile-cache hits), so
-            # cap at a power of two to keep the memory bound honest
-            cap_p2 = 1
-            while cap_p2 * 2 <= batch_cap:
-                cap_p2 *= 2
-            batch_cap = cap_p2
+            raw_cap = self.max_dirs_bytes // (max_len * (band // 4))
+            # chunks pad to mesh_size * 2^k (see _pad_batch), so cap at the
+            # largest such size to keep the memory bound honest
+            from ..parallel import mesh_size
+            batch_cap = mesh_size(self.mesh)
+            while batch_cap * 2 <= raw_cap:
+                batch_cap *= 2
             escaped: List[int] = []
             for start in range(0, len(indices), batch_cap):
                 chunk = indices[start:start + batch_cap]
@@ -288,9 +315,7 @@ class TpuAligner:
     def _run_chunk(self, pairs, chunk, max_len, band, cigars, reject):
         # Pad the batch to a power of two: B is part of the compiled shape,
         # so arbitrary batch sizes would recompile the kernels every call.
-        B = 1
-        while B < len(chunk):
-            B *= 2
+        B = self._pad_batch(len(chunk))
         c = band // 2
         width = c + max_len + band
         qrp = np.zeros((B, width), dtype=np.uint8)
@@ -305,11 +330,13 @@ class TpuAligner:
             n[k], m[k] = len(qb), len(tb)
 
         nd, md = jnp.asarray(n), jnp.asarray(m)
-        packed, score = _nw_wavefront_kernel(
-            jnp.asarray(qrp), jnp.asarray(tp), nd, md,
-            max_len=max_len, band=band)
-        out = _traceback_kernel(packed, score, nd, md,
-                                max_len=max_len, band=band)
+        if self.mesh is not None:
+            from ..parallel import sharded_align
+            out = sharded_align(self.mesh, jnp.asarray(qrp), jnp.asarray(tp),
+                                nd, md, max_len=max_len, band=band)
+        else:
+            out = align_chain(jnp.asarray(qrp), jnp.asarray(tp), nd, md,
+                              max_len=max_len, band=band)
         ops_packed, score, fi, fj = jax.device_get(out)
         # unpack 4 codes/byte -> [B, 2L] uint8
         shifts = np.array([0, 2, 4, 6], dtype=np.uint8)

@@ -165,6 +165,24 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
     return winner, coverage, ins_winner, ins_emit, ins_cov
 
 
+def consensus_chain(qrp, tp, n, m, qcodes, qweights, begin, win_of,
+                    bcodes, bweights, blen, *, n_windows: int, max_len: int,
+                    band: int, L: int, K: int):
+    """Align + vote + pick-winners — the single source of truth for the
+    consensus engine's kernel wiring, wrapped unchanged by the plain path
+    (``TpuPoaConsensus._device_round``) and the ``shard_map`` path
+    (``racon_tpu.parallel.sharded_consensus_round``). Returns
+    ``(winner, coverage, ins_winner, ins_emit, ins_cov, ok)``."""
+    packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
+                                         max_len=max_len, band=band)
+    weighted, unweighted, ok = _vote_kernel(
+        packed, score, n, m, qcodes, qweights, begin, win_of,
+        n_windows=n_windows, max_len=max_len, band=band, L=L, K=K)
+    out = _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
+                            L=L, K=K)
+    return out + (ok,)
+
+
 class _Work:
     """Mutable per-window state across refinement rounds."""
 
@@ -194,13 +212,15 @@ class TpuPoaConsensus:
     """
 
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
-                 max_depth: int = 200, band: int = BAND, rounds: int = 3):
+                 max_depth: int = 200, band: int = BAND, rounds: int = 3,
+                 mesh=None):
         # match/mismatch/gap kept for interface parity; the pileup engine
         # votes by base weight rather than alignment score.
         self.fallback = fallback
         self.max_depth = max_depth
         self.band = band
         self.rounds = rounds
+        self.mesh = mesh
         self.stats = {"device_windows": 0, "fallback_windows": 0,
                       "dropped_layers": 0, "passthrough": 0}
 
@@ -271,25 +291,15 @@ class TpuPoaConsensus:
 
     # -------------------------------------------------------------- device
 
-    def _device_round(self, live, L, Lq) -> None:
-        """One align+vote+consensus pass; updates each _Work in place."""
+    def _pack_shard(self, items, L, Lq, B, nWp):
+        """Pack one shard's windows into fixed-shape pair/window arrays.
+
+        ``items`` is a list of ``(result_index, _Work)``; pair rows beyond
+        the shard's real pairs vote into the sink window ``nWp - 1``.
+        """
         band = self.band
         c = band // 2
         width = c + Lq + band
-
-        pair_entries = []  # (local window ordinal, layer index)
-        for wi, (_, w) in enumerate(live):
-            for li in range(len(w.layers)):
-                pair_entries.append((wi, li))
-
-        nW = len(live)
-        nP = len(pair_entries)
-        B = 1
-        while B < nP:
-            B *= 2
-        nWp = 1
-        while nWp < nW + 1:
-            nWp *= 2
 
         qrp = np.zeros((B, width), np.uint8)
         tp = np.zeros((B, width), np.uint8)
@@ -300,30 +310,31 @@ class TpuPoaConsensus:
         begin = np.zeros(B, np.int32)
         win_of = np.full(B, nWp - 1, np.int32)  # padding -> sink window
 
-        for k, (wi, li) in enumerate(pair_entries):
-            w = live[wi][1]
-            seq, qual, bg, ed = w.layers[li]
-            bb = w.backbone
-            bg = min(bg, len(bb) - 1)
-            ed = min(ed, len(bb) - 1)
-            span = bb[bg:ed + 1]
-            qrp[k, c + Lq - len(seq): c + Lq] = \
-                np.frombuffer(seq, np.uint8)[::-1]
-            tp[k, c: c + len(span)] = np.frombuffer(span, np.uint8)
-            n[k], m[k] = len(seq), len(span)
-            qcodes[k, :len(seq)] = _CODE_LUT[np.frombuffer(seq, np.uint8)]
-            if qual is not None:
-                qweights[k, :len(seq)] = \
-                    np.frombuffer(qual, np.uint8).astype(np.float32) - 33.0
-            else:
-                qweights[k, :len(seq)] = 1.0
-            begin[k] = bg
-            win_of[k] = wi
+        k = 0
+        for wi, (_, w) in enumerate(items):
+            for seq, qual, bg, ed in w.layers:
+                bb = w.backbone
+                bg = min(bg, len(bb) - 1)
+                ed = min(ed, len(bb) - 1)
+                span = bb[bg:ed + 1]
+                qrp[k, c + Lq - len(seq): c + Lq] = \
+                    np.frombuffer(seq, np.uint8)[::-1]
+                tp[k, c: c + len(span)] = np.frombuffer(span, np.uint8)
+                n[k], m[k] = len(seq), len(span)
+                qcodes[k, :len(seq)] = _CODE_LUT[np.frombuffer(seq, np.uint8)]
+                if qual is not None:
+                    qweights[k, :len(seq)] = \
+                        np.frombuffer(qual, np.uint8).astype(np.float32) - 33.0
+                else:
+                    qweights[k, :len(seq)] = 1.0
+                begin[k] = bg
+                win_of[k] = wi
+                k += 1
 
         bcodes = np.zeros((nWp, L), np.uint8)
         bweights = np.zeros((nWp, L), np.float32)
         blen = np.zeros(nWp, np.int32)
-        for wi, (_, w) in enumerate(live):
+        for wi, (_, w) in enumerate(items):
             bb = w.backbone
             bcodes[wi, :len(bb)] = _CODE_LUT[np.frombuffer(bb, np.uint8)]
             if w.bqual is not None:
@@ -331,23 +342,74 @@ class TpuPoaConsensus:
                     np.frombuffer(w.bqual, np.uint8).astype(np.float32) - 33.0
             blen[wi] = len(bb)
 
-        packed, score = _nw_wavefront_kernel(
-            jnp.asarray(qrp), jnp.asarray(tp), jnp.asarray(n), jnp.asarray(m),
-            max_len=Lq, band=band)
-        weighted, unweighted, ok = _vote_kernel(
-            packed, score, jnp.asarray(n), jnp.asarray(m),
-            jnp.asarray(qcodes), jnp.asarray(qweights), jnp.asarray(begin),
-            jnp.asarray(win_of), n_windows=nWp,
-            max_len=Lq, band=band, L=L, K=K_INS)
-        out = _consensus_kernel(weighted, unweighted,
-                                jnp.asarray(bcodes), jnp.asarray(bweights),
-                                jnp.asarray(blen), L=L, K=K_INS)
-        winner, coverage, ins_winner, ins_emit, ins_cov = (
-            np.asarray(x) for x in jax.device_get(out))
-        ok = np.asarray(jax.device_get(ok))
-        self.stats["dropped_layers"] += int((~ok[:nP]).sum())
+        return (qrp, tp, n, m, qcodes, qweights, begin, win_of), \
+               (bcodes, bweights, blen), k
 
-        for wi, (_, w) in enumerate(live):
+    def _device_round(self, live, L, Lq) -> None:
+        """One align+vote+consensus pass; updates each _Work in place.
+
+        With a mesh, windows are LPT-binned into one shard per device
+        (pairs of a window never cross shards, so votes stay shard-local)
+        and all shards run in one ``shard_map`` call; without one, the
+        whole batch is a single shard on the default device.
+        """
+        from ..parallel import (mesh_size, partition_balanced,
+                                sharded_consensus_round)
+        band = self.band
+        nd = mesh_size(self.mesh)
+        if nd == 1:
+            shards = [list(live)]
+        else:
+            bins = partition_balanced([len(w.layers) for _, w in live], nd)
+            shards = [[live[i] for i in b] for b in bins]
+
+        max_pairs = max(sum(len(w.layers) for _, w in sh) for sh in shards)
+        max_wins = max(len(sh) for sh in shards)
+        B = 1
+        while B < max(max_pairs, 1):
+            B *= 2
+        nWp = 1
+        while nWp < max_wins + 1:
+            nWp *= 2
+
+        packs = [self._pack_shard(sh, L, Lq, B, nWp) for sh in shards]
+
+        if nd == 1:
+            pair_arrays, window_arrays, nP = packs[0]
+            out = consensus_chain(
+                *(jnp.asarray(a) for a in pair_arrays),
+                *(jnp.asarray(a) for a in window_arrays),
+                n_windows=nWp, max_len=Lq, band=band, L=L, K=K_INS)
+            res = jax.device_get(out)
+            shard_results = [tuple(np.asarray(x) for x in res)]
+            n_pairs = [nP]
+        else:
+            pair_stk = [np.concatenate([p[0][a] for p in packs])
+                        for a in range(8)]
+            win_stk = [np.concatenate([p[1][a] for p in packs])
+                       for a in range(3)]
+            out = sharded_consensus_round(
+                self.mesh,
+                tuple(jnp.asarray(a) for a in pair_stk),
+                tuple(jnp.asarray(a) for a in win_stk),
+                n_windows_local=nWp, max_len=Lq, band=band, L=L, K=K_INS)
+            res = [np.asarray(x) for x in jax.device_get(out)]
+            shard_results = []
+            for s in range(nd):
+                shard_results.append(tuple(
+                    r[s * nWp:(s + 1) * nWp] if r.shape[0] == nd * nWp
+                    else r[s * B:(s + 1) * B] for r in res))
+            n_pairs = [p[2] for p in packs]
+
+        for sh, (winner, coverage, ins_winner, ins_emit, ins_cov, ok), nP \
+                in zip(shards, shard_results, n_pairs):
+            self.stats["dropped_layers"] += int((~ok[:nP]).sum())
+            self._apply_shard(sh, winner, coverage, ins_winner, ins_emit,
+                              ins_cov)
+
+    def _apply_shard(self, items, winner, coverage, ins_winner, ins_emit,
+                     ins_cov) -> None:
+        for wi, (_, w) in enumerate(items):
             blen_i = len(w.backbone)
             out_bytes = bytearray()
             covs: List[int] = []

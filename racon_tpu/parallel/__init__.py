@@ -1,0 +1,129 @@
+"""racon_tpu.parallel — multi-chip dispatch over a ``jax.sharding.Mesh``.
+
+Reference analog: the CUDA driver round-robins its batches across every
+visible GPU (``src/cuda/cudapolisher.cpp:72-83,163-171,217-228``).  The
+TPU-native equivalent is single-program data sharding: windows and overlap
+pairs are embarrassingly parallel (SURVEY §2.3), so the fixed-shape device
+batches built by :mod:`racon_tpu.ops` are split along their batch dimension
+over a 1-D device mesh with :func:`jax.shard_map`.  Each chip runs the same
+compiled kernels on its slice; there are **no collectives in the hot path**
+(the scatter-add vote accumulators are window-major and windows never span
+shards), so scaling rides ICI bandwidth-free and multi-host meshes over DCN
+work unchanged.
+
+Public surface:
+
+- :func:`get_mesh` — build a 1-D mesh over (a prefix of) the local devices;
+- :func:`sharded_align` — batched wavefront-NW + on-device traceback,
+  batch dim sharded (used by :class:`racon_tpu.ops.nw.TpuAligner`);
+- :func:`sharded_consensus_round` — one align+vote+consensus pass with
+  pair arrays and window arrays co-sharded (used by
+  :class:`racon_tpu.ops.poa.TpuPoaConsensus`);
+- :func:`partition_balanced` — greedy LPT binning of variable-cost items
+  into per-shard groups (host-side analog of the reference's dynamic work
+  queue, ``src/cuda/cudapolisher.cpp:98-118``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "d"
+
+
+def get_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D mesh named ``d`` over ``n_devices`` (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} visible")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def mesh_size(mesh: Optional[Mesh]) -> int:
+    return 1 if mesh is None else mesh.shape[AXIS]
+
+
+def partition_balanced(costs: Sequence[int], n_bins: int) -> List[List[int]]:
+    """Greedy longest-processing-time binning: returns per-bin item indices.
+
+    Host-side replacement for the reference's mutex'd shared-index work
+    queue — with fixed-shape device batches the binning happens up front.
+    """
+    order = sorted(range(len(costs)), key=lambda i: -costs[i])
+    bins: List[List[int]] = [[] for _ in range(n_bins)]
+    loads = [0] * n_bins
+    for i in order:
+        b = loads.index(min(loads))
+        bins[b].append(i)
+        loads[b] += costs[i]
+    return bins
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_align_fn(mesh: Mesh, max_len: int, band: int):
+    from ..ops.nw import align_chain
+
+    def local(qrp, tp, n, m):
+        return align_chain(qrp, tp, n, m, max_len=max_len, band=band)
+
+    spec = P(AXIS)
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=(spec, spec, spec, spec),
+                                 out_specs=(spec, spec, spec, spec),
+                                 check_vma=False))
+
+
+def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int):
+    """NW + traceback with the batch dimension split over ``mesh``.
+
+    Batch size must be a multiple of the mesh size (callers pad).
+    Returns ``(ops_packed, score, fi, fj)`` exactly like the single-device
+    ``_traceback_kernel``.
+    """
+    return _sharded_align_fn(mesh, max_len, band)(qrp, tp, n, m)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_consensus_fn(mesh: Mesh, n_windows_local: int, max_len: int,
+                          band: int, L: int, K: int):
+    from ..ops.poa import consensus_chain
+
+    def local(qrp, tp, n, m, qcodes, qweights, begin, win_of,
+              bcodes, bweights, blen):
+        return consensus_chain(qrp, tp, n, m, qcodes, qweights, begin,
+                               win_of, bcodes, bweights, blen,
+                               n_windows=n_windows_local, max_len=max_len,
+                               band=band, L=L, K=K)
+
+    spec = P(AXIS)
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(spec,) * 11, out_specs=(spec,) * 6,
+        check_vma=False))
+
+
+def sharded_consensus_round(mesh: Mesh, pair_arrays, window_arrays, *,
+                            n_windows_local: int, max_len: int, band: int,
+                            L: int, K: int):
+    """One consensus pass (align + vote + winners) over a co-sharded batch.
+
+    ``pair_arrays`` = (qrp, tp, n, m, qcodes, qweights, begin, win_of) with
+    leading dim ``n_shards * B_local``; ``win_of`` holds **shard-local**
+    window ordinals.  ``window_arrays`` = (bcodes, bweights, blen) with
+    leading dim ``n_shards * n_windows_local``.  Pairs belonging to one
+    window must live in that window's shard — :func:`partition_balanced`
+    plus per-shard packing guarantees it, so no cross-shard reduction is
+    needed.  Returns ``(winner, coverage, ins_winner, ins_emit, ins_cov,
+    ok)`` stacked the same way.
+    """
+    fn = _sharded_consensus_fn(mesh, n_windows_local, max_len, band, L, K)
+    return fn(*pair_arrays, *window_arrays)

@@ -4,11 +4,19 @@ real multi-chip path separately via __graft_entry__.dryrun_multichip)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+if os.environ.get("RACON_TPU_TEST_REAL", "") != "1":
+    # The environment may pre-register an accelerator plugin (and pin
+    # jax_platforms) from sitecustomize, so an env var alone is not enough:
+    # override the config before any backend initializes.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 

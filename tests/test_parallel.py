@@ -1,0 +1,115 @@
+"""Multi-device dispatch tests on the virtual 8-CPU mesh (conftest forces
+``--xla_force_host_platform_device_count=8``). Sharded results must be
+identical to single-device results — windows/pairs are embarrassingly
+parallel, so sharding must not change any output byte (reference analog:
+multi-GPU binning changes nothing about per-batch results,
+``src/cuda/cudapolisher.cpp:72-83``)."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from racon_tpu.parallel import get_mesh, mesh_size, partition_balanced
+from racon_tpu.ops.nw import TpuAligner
+from racon_tpu.ops.poa import TpuPoaConsensus
+from racon_tpu.core.window import Window, WindowType
+
+
+def _random_pairs(count, lo=60, hi=200, err=0.12, seed=5):
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    pairs = []
+    for _ in range(count):
+        ln = int(rng.integers(lo, hi))
+        t = bases[rng.integers(0, 4, ln)]
+        q = t.copy()
+        flips = rng.random(ln) < err
+        q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        pairs.append((q.tobytes(), t.tobytes()))
+    return pairs
+
+
+def _random_windows(count, depth=5, blen=64, seed=9):
+    rng = np.random.default_rng(seed)
+    bases = b"ACGT"
+    windows = []
+    for k in range(count):
+        backbone = bytes(bases[i] for i in rng.integers(0, 4, blen))
+        win = Window(0, k, WindowType.TGS, backbone, b"5" * blen)
+        for _ in range(depth):
+            layer = bytearray(backbone)
+            for p in rng.integers(1, blen - 1, 4):
+                layer[p] = bases[int(rng.integers(0, 4))]
+            win.add_layer(bytes(layer), b"9" * len(layer), 0, blen - 1)
+        windows.append(win)
+    return windows
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    assert mesh_size(get_mesh()) == 8
+    assert mesh_size(get_mesh(4)) == 4
+    assert mesh_size(None) == 1
+
+
+def test_partition_balanced():
+    costs = [9, 1, 1, 1, 8, 2, 2, 4]
+    bins = partition_balanced(costs, 3)
+    assert sorted(i for b in bins for i in b) == list(range(8))
+    loads = [sum(costs[i] for i in b) for b in bins]
+    assert max(loads) <= 10  # LPT on this input: 9|8+1|4+2+2+1 -> 9/9/10
+
+
+def test_sharded_aligner_matches_single_device():
+    pairs = _random_pairs(37)
+    single = TpuAligner(buckets=((256, 128),))
+    sharded = TpuAligner(buckets=((256, 128),), mesh=get_mesh())
+    c1 = single.align_batch(pairs)
+    c2 = sharded.align_batch(pairs)
+    assert c1 == c2
+    assert sharded.stats["device"] == len(pairs)
+
+
+def test_sharded_aligner_smaller_mesh():
+    pairs = _random_pairs(10, seed=6)
+    sharded = TpuAligner(buckets=((256, 128),), mesh=get_mesh(4))
+    single = TpuAligner(buckets=((256, 128),))
+    assert sharded.align_batch(pairs) == single.align_batch(pairs)
+
+
+def test_sharded_consensus_matches_single_device():
+    wins_a = _random_windows(13)
+    wins_b = _random_windows(13)
+    single = TpuPoaConsensus(3, -5, -4, band=64, rounds=2)
+    sharded = TpuPoaConsensus(3, -5, -4, band=64, rounds=2, mesh=get_mesh())
+    f1 = single.run(wins_a, trim=True)
+    f2 = sharded.run(wins_b, trim=True)
+    assert f1 == f2
+    assert [w.consensus for w in wins_a] == [w.consensus for w in wins_b]
+    assert sharded.stats["device_windows"] == len(wins_b)
+
+
+def test_sharded_consensus_fewer_windows_than_devices():
+    wins_a = _random_windows(3, seed=21)
+    wins_b = _random_windows(3, seed=21)
+    single = TpuPoaConsensus(3, -5, -4, band=64, rounds=1)
+    sharded = TpuPoaConsensus(3, -5, -4, band=64, rounds=1, mesh=get_mesh())
+    single.run(wins_a, trim=False)
+    sharded.run(wins_b, trim=False)
+    assert [w.consensus for w in wins_a] == [w.consensus for w in wins_b]
+
+
+def test_dryrun_multichip():
+    import pathlib
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    try:
+        from __graft_entry__ import dryrun_multichip, entry
+    finally:
+        sys.path.pop(0)
+    fn, args = entry()
+    packed, score = jax.jit(fn)(*args)
+    assert int(jax.device_get(score).min()) >= 0
+    dryrun_multichip(8)
