@@ -1,9 +1,14 @@
 // Host-native pairwise global aligner (edlib-equivalent role).
 //
-// Banded unit-cost Needleman-Wunsch with traceback -> CIGAR, band doubling
-// until the optimum provably lies inside the band (score <= band - |n-m|),
-// plus a bit-parallel Myers/Hyyro edit-distance (score only) used as the
-// consensus-quality metric. Reference call sites this replaces:
+// Primary path: Myers/Hyyro bit-parallel global alignment (64 DP cells per
+// machine word) with per-column {Pv, Mv, block-bottom-score} storage and an
+// O(1) popcount cell lookup for the value-based traceback.  The traceback
+// tie-break rule (M on diagonal ties, then I, then D) reproduces the
+// direction choices of the banded scalar DP it replaced, so CIGARs are
+// bit-identical to round-1 outputs and all pipeline goldens are unchanged.
+// Pairs whose traceback storage would exceed kMyersMemLimit fall back to
+// the banded scalar DP with band doubling.  A score-only Myers pass serves
+// as the consensus-quality metric.  Reference call sites this replaces:
 // edlibAlign at src/overlap.cpp:205-224 and the test metric at
 // test/racon_test.cpp:16-25 of the reference tree.
 //
@@ -21,6 +26,7 @@
 namespace {
 
 constexpr int32_t kBig = 1 << 28;
+constexpr int64_t kMyersMemLimit = 256ll * 1024 * 1024;  // traceback storage
 
 struct Cigar {
     std::string s;
@@ -44,6 +50,126 @@ struct Cigar {
         }
     }
 };
+
+// ------------------------------------------------------------------ Myers
+
+// One 64-row block step of the Myers/Hyyro bit-parallel edit-distance
+// automaton.  Pv/Mv hold the +1/-1 vertical deltas of this block's rows;
+// hin/hout are the horizontal deltas entering/leaving the block.  When
+// `ph_out`/`mh_out` are non-null the pre-shift horizontal-delta words are
+// exported (bit k = delta at row base+k+1).
+static inline int adv_block(uint64_t& Pv, uint64_t& Mv, uint64_t Eq, int hin,
+                            uint64_t* ph_out = nullptr,
+                            uint64_t* mh_out = nullptr) {
+    uint64_t Xv = Eq | Mv;
+    if (hin < 0) Eq |= 1ull;
+    uint64_t Xh = (((Eq & Pv) + Pv) ^ Pv) | Eq;
+    uint64_t Ph = Mv | ~(Xh | Pv);
+    uint64_t Mh = Pv & Xh;
+    int hout = (int)(Ph >> 63) - (int)(Mh >> 63);
+    if (ph_out) *ph_out = Ph;
+    if (mh_out) *mh_out = Mh;
+    Ph <<= 1;
+    Mh <<= 1;
+    if (hin > 0) Ph |= 1ull;
+    else if (hin < 0) Mh |= 1ull;
+    Pv = Mh | ~(Xv | Ph);
+    Mv = Ph & Xv;
+    return hout;
+}
+
+static void build_peq(const char* q, int64_t n, int64_t W,
+                      std::vector<uint64_t>& peq) {
+    peq.assign(256 * W, 0);
+    for (int64_t i = 0; i < n; ++i) {
+        peq[(uint8_t)q[i] * W + i / 64] |= 1ull << (i % 64);
+    }
+}
+
+// Score-only global edit distance; exact, O(m * n/64).
+int64_t myers_distance(const char* q, int64_t n, const char* t, int64_t m) {
+    if (n == 0) return m;
+    if (m == 0) return n;
+    int64_t W = (n + 63) / 64;
+    std::vector<uint64_t> peq;
+    build_peq(q, n, W, peq);
+    std::vector<uint64_t> Pv(W, ~0ull), Mv(W, 0);
+    int64_t score = n;  // cell (n, 0)
+    int nbit = (n - 1) % 64;
+    for (int64_t j = 0; j < m; ++j) {
+        const uint64_t* eq = &peq[(uint8_t)t[j] * W];
+        int hin = 1;  // row-0 boundary grows by 1 per column
+        for (int64_t b = 0; b < W - 1; ++b) {
+            hin = adv_block(Pv[b], Mv[b], eq[b], hin);
+        }
+        uint64_t ph, mh;
+        adv_block(Pv[W - 1], Mv[W - 1], eq[W - 1], hin, &ph, &mh);
+        score += (int64_t)((ph >> nbit) & 1) - (int64_t)((mh >> nbit) & 1);
+    }
+    return score;
+}
+
+// Full fill with per-column traceback storage.  ps/ms[(j-1)*W + b] hold the
+// block's vertical-delta words after column j; ss holds the score at the
+// block's bottom row ((b+1)*64, which may lie in the padding below row n —
+// padding rows never match, and carries only propagate downward, so rows
+// <= n are unaffected).  Returns the exact distance.
+int64_t myers_fill(const char* q, int64_t n, const char* t, int64_t m,
+                   std::vector<uint64_t>& ps, std::vector<uint64_t>& ms,
+                   std::vector<int32_t>& ss) {
+    int64_t W = (n + 63) / 64;
+    std::vector<uint64_t> peq;
+    build_peq(q, n, W, peq);
+    ps.resize(W * m);
+    ms.resize(W * m);
+    ss.resize(W * m);
+    std::vector<uint64_t> Pv(W, ~0ull), Mv(W, 0);
+    std::vector<int32_t> bs(W);
+    for (int64_t b = 0; b < W; ++b) bs[b] = (int32_t)((b + 1) * 64);
+    int64_t score = n;
+    int nbit = (n - 1) % 64;
+    for (int64_t j = 0; j < m; ++j) {
+        const uint64_t* eq = &peq[(uint8_t)t[j] * W];
+        uint64_t* prow = &ps[j * W];
+        uint64_t* mrow = &ms[j * W];
+        int32_t* srow = &ss[j * W];
+        int hin = 1;
+        for (int64_t b = 0; b < W; ++b) {
+            uint64_t ph, mh;
+            int hout = adv_block(Pv[b], Mv[b], eq[b], hin, &ph, &mh);
+            if (b == W - 1) {
+                score += (int64_t)((ph >> nbit) & 1) -
+                         (int64_t)((mh >> nbit) & 1);
+            }
+            bs[b] += hout;
+            prow[b] = Pv[b];
+            mrow[b] = Mv[b];
+            srow[b] = bs[b];
+            hin = hout;
+        }
+    }
+    return score;
+}
+
+struct MyersCells {
+    const std::vector<uint64_t>& ps;
+    const std::vector<uint64_t>& ms;
+    const std::vector<int32_t>& ss;
+    int64_t W;
+    // Value of DP cell (i, j), 0 <= i <= n, 0 <= j <= m.
+    int64_t operator()(int64_t i, int64_t j) const {
+        if (j == 0) return i;
+        if (i == 0) return j;
+        int64_t b = (i - 1) / 64;
+        int64_t ib = i - b * 64;  // 1..64: rows > i within the block
+        uint64_t mask = (ib >= 64) ? 0ull : (~0ull << ib);
+        int64_t idx = (j - 1) * W + b;
+        return ss[idx] - __builtin_popcountll(ps[idx] & mask) +
+               __builtin_popcountll(ms[idx] & mask);
+    }
+};
+
+// --------------------------------------------------- banded scalar (fallback)
 
 // One banded DP attempt. Returns score or -1 if the end cell fell outside
 // the band. When `dirs` is non-null it is filled for traceback.
@@ -97,10 +223,8 @@ int64_t banded_pass(const char* q, int64_t n, const char* t, int64_t m,
     return score >= kBig ? -1 : score;
 }
 
-std::string nw_cigar_impl(const char* q, int64_t n, const char* t, int64_t m) {
-    if (n == 0) return m ? std::to_string(m) + "D" : "";
-    if (m == 0) return std::to_string(n) + "I";
-
+std::string banded_cigar_impl(const char* q, int64_t n, const char* t,
+                              int64_t m) {
     int64_t diff = std::llabs(n - m);
     int64_t band = std::max<int64_t>(32, diff + 8);
     int64_t maxlen = std::max(n, m);
@@ -112,7 +236,6 @@ std::string nw_cigar_impl(const char* q, int64_t n, const char* t, int64_t m) {
         int64_t score = banded_pass(q, n, t, m, band, dirs.data(), width);
         if (score >= 0 && (score <= band - diff || band >= maxlen)) {
             // traceback
-            Cigar rev;
             int64_t i = n, j = m;
             std::string ops;
             ops.reserve(n + m);
@@ -142,20 +265,58 @@ std::string nw_cigar_impl(const char* q, int64_t n, const char* t, int64_t m) {
     }
 }
 
-// Global edit distance, score only: banded DP with band doubling.
-// O(edits * len) — ~0.1s for a 48.5 kbp genome at ~3% divergence.
-int64_t distance_impl(const char* a, int64_t m, const char* b, int64_t n) {
-    if (m == 0) return n;
-    if (n == 0) return m;
-    int64_t diff = std::llabs(m - n);
-    int64_t band = std::max<int64_t>(64, diff + 8);
-    int64_t maxlen = std::max(m, n);
-    while (true) {
-        int64_t s = banded_pass(a, m, b, n, band, nullptr, 0);
-        if (s >= 0 && (s <= band - diff || band >= maxlen)) return s;
-        band *= 2;
-        if (band > 2 * maxlen) band = maxlen;
+// ------------------------------------------------------------------ dispatch
+
+std::string nw_cigar_impl(const char* q, int64_t n, const char* t, int64_t m) {
+    if (n == 0) return m ? std::to_string(m) + "D" : "";
+    if (m == 0) return std::to_string(n) + "I";
+
+    int64_t W = (n + 63) / 64;
+    if (W * m * (int64_t)(2 * sizeof(uint64_t) + sizeof(int32_t)) >
+        kMyersMemLimit) {
+        return banded_cigar_impl(q, n, t, m);
     }
+
+    thread_local std::vector<uint64_t> ps, ms;
+    thread_local std::vector<int32_t> ss;
+    int64_t score = myers_fill(q, n, t, m, ps, ms, ss);
+    MyersCells cell{ps, ms, ss, W};
+
+    // Value-based traceback; tie-breaks (M over I over D) replicate the
+    // banded scalar fill's direction preferences exactly.
+    std::string ops;
+    ops.reserve(n + m);
+    int64_t i = n, j = m, v = score;
+    while (i > 0 && j > 0) {
+        int64_t diag = cell(i - 1, j - 1);
+        if (diag + (q[i - 1] != t[j - 1]) == v) {
+            ops += 'M';
+            --i; --j;
+            v = diag;
+            continue;
+        }
+        int64_t up = cell(i - 1, j);
+        if (up + 1 == v) {
+            ops += 'I';
+            --i;
+            v = up;
+            continue;
+        }
+        ops += 'D';
+        --j;
+        v = cell(i, j);
+    }
+    if (i > 0) ops.append(i, 'I');
+    if (j > 0) ops.append(j, 'D');
+    std::reverse(ops.begin(), ops.end());
+    Cigar c;
+    for (char op : ops) c.push(op);
+    c.flush();
+    return c.s;
+}
+
+int64_t distance_impl(const char* a, int64_t m, const char* b, int64_t n) {
+    return myers_distance(a, m, b, n);
 }
 
 }  // namespace
