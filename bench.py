@@ -47,6 +47,7 @@ def bench_consensus(windows):
     tpu.run(windows, trim=True)
     cold = time.perf_counter() - t0
     log(f"cold: {cold:.2f}s, stats={tpu.stats}")
+    tpu.stats = {k: 0 for k in tpu.stats}  # report warm-run stats only
 
     log("TPU consensus: warm run...")
     t0 = time.perf_counter()

@@ -45,11 +45,9 @@ class NativeAligner:
         return native.nw_cigar_batch(list(pairs), num_threads=self.num_threads)
 
 
-class CpuPoaConsensus:
-    """Spoa-semantics POA over windows (reference CPU path,
-    ``src/polisher.cpp:490-503``). The Python engine is sequential (GIL);
-    ``num_threads`` is honored once the native C++ POA engine is selected.
-    """
+class PythonPoaConsensus:
+    """Spoa-semantics POA over windows in pure Python (sequential; the
+    oracle the native engine is validated against)."""
 
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1):
@@ -58,6 +56,43 @@ class CpuPoaConsensus:
 
     def run(self, windows, trim: bool) -> List[bool]:
         return [w.generate_consensus(self.engine, trim) for w in windows]
+
+
+class NativePoaConsensus:
+    """C++ POA engine threaded over windows (reference CPU path,
+    ``src/polisher.cpp:490-503`` with per-thread spoa engines). Produces
+    byte-identical consensuses to :class:`PythonPoaConsensus`; windows the
+    native engine flags as failed are re-polished by the Python engine."""
+
+    def __init__(self, match: int, mismatch: int, gap: int,
+                 num_threads: int = 1):
+        if not native.available():
+            raise RuntimeError("native library unavailable")
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.num_threads = num_threads
+        self.engine = PoaAlignmentEngine(match, mismatch, gap)
+
+    def run(self, windows, trim: bool) -> List[bool]:
+        results = native.poa_consensus_batch(
+            windows, trim, self.match, self.mismatch, self.gap,
+            self.num_threads)
+        flags: List[bool] = []
+        for w, (consensus, polished, failed) in zip(windows, results):
+            if failed:
+                flags.append(w.generate_consensus(self.engine, trim))
+            else:
+                w.consensus = consensus
+                flags.append(polished)
+        return flags
+
+
+# Historical alias: the CPU consensus used by tests/benchmarks; prefers the
+# threaded native engine and falls back to pure Python.
+def CpuPoaConsensus(match: int, mismatch: int, gap: int,
+                    num_threads: int = 1):
+    if native.available():
+        return NativePoaConsensus(match, mismatch, gap, num_threads)
+    return PythonPoaConsensus(match, mismatch, gap, num_threads)
 
 
 def make_aligner(backend: str, num_threads: int):
@@ -81,7 +116,11 @@ def make_aligner(backend: str, num_threads: int):
 
 def make_consensus(backend: str, match: int, mismatch: int, gap: int,
                    num_threads: int = 1):
-    if backend in ("cpu", "auto", "python"):
+    if backend == "python":
+        return PythonPoaConsensus(match, mismatch, gap, num_threads)
+    if backend in ("native", "cpu"):
+        return NativePoaConsensus(match, mismatch, gap, num_threads)
+    if backend == "auto":
         return CpuPoaConsensus(match, mismatch, gap, num_threads)
     if backend == "tpu":
         try:

@@ -73,6 +73,17 @@ def load():
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p)]
+    lib.rt_poa_consensus_batch.restype = None
+    lib.rt_poa_consensus_batch.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8)]
     lib.rt_free.restype = None
     lib.rt_free.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -100,6 +111,68 @@ def edit_distance(a: bytes, b: bytes) -> int:
     if lib is None:
         raise NativeBuildError("native library unavailable")
     return lib.rt_edit_distance(a, len(a), b, len(b))
+
+
+def poa_consensus_batch(windows, trim: bool, match: int, mismatch: int,
+                        gap: int, num_threads: int = 1) -> list:
+    """Spoa-semantics consensus for a batch of Window objects on the C++
+    thread pool (host analog of the reference's per-window futures,
+    src/polisher.cpp:490-503). Returns ``[(consensus bytes, polished,
+    failed), ...]``; ``failed`` windows should fall back to the Python
+    engine."""
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    nw = len(windows)
+    if nw == 0:
+        return []
+
+    first = [0]
+    seqs, lens, quals, has_qual, begins, ends = [], [], [], [], [], []
+    ids, ranks, is_tgs = [], [], []
+    from ..core.window import WindowType
+    for w in windows:
+        for i, seq in enumerate(w.sequences):
+            seqs.append(seq)
+            lens.append(len(seq))
+            q = w.qualities[i]
+            quals.append(q if q is not None else b"")
+            has_qual.append(1 if q is not None else 0)
+            b, e = w.positions[i]
+            begins.append(b)
+            ends.append(e)
+        first.append(len(seqs))
+        ids.append(w.id)
+        ranks.append(w.rank)
+        is_tgs.append(1 if w.type == WindowType.TGS else 0)
+
+    ns = len(seqs)
+    c_first = (ctypes.c_int64 * (nw + 1))(*first)
+    c_seqs = (ctypes.c_char_p * ns)(*seqs)
+    c_lens = (ctypes.c_int64 * ns)(*lens)
+    c_quals = (ctypes.c_char_p * ns)(*quals)
+    c_hasq = (ctypes.c_uint8 * ns)(*has_qual)
+    c_begins = (ctypes.c_int64 * ns)(*begins)
+    c_ends = (ctypes.c_int64 * ns)(*ends)
+    c_ids = (ctypes.c_int64 * nw)(*ids)
+    c_ranks = (ctypes.c_int64 * nw)(*ranks)
+    c_tgs = (ctypes.c_uint8 * nw)(*is_tgs)
+    c_out = (ctypes.c_void_p * nw)()
+    c_outlen = (ctypes.c_int64 * nw)()
+    c_pol = (ctypes.c_uint8 * nw)()
+    c_status = (ctypes.c_uint8 * nw)()
+
+    lib.rt_poa_consensus_batch(
+        nw, c_first, c_seqs, c_lens, c_quals, c_hasq, c_begins, c_ends,
+        c_ids, c_ranks, c_tgs, 1 if trim else 0, match, mismatch, gap,
+        num_threads, c_out, c_outlen, c_pol, c_status)
+
+    result = []
+    for i in range(nw):
+        data = ctypes.string_at(c_out[i], c_outlen[i])
+        lib.rt_free(c_out[i])
+        result.append((data, bool(c_pol[i]), bool(c_status[i])))
+    return result
 
 
 def nw_cigar_batch(pairs, num_threads: int = 1) -> list:

@@ -394,11 +394,12 @@ class TpuPoaConsensus:
                 tuple(jnp.asarray(a) for a in win_stk),
                 n_windows_local=nWp, max_len=Lq, band=band, L=L, K=K_INS)
             res = [np.asarray(x) for x in jax.device_get(out)]
+            # fixed output order: five window-major arrays, then pair-major ok
+            strides = (nWp, nWp, nWp, nWp, nWp, B)
             shard_results = []
             for s in range(nd):
                 shard_results.append(tuple(
-                    r[s * nWp:(s + 1) * nWp] if r.shape[0] == nd * nWp
-                    else r[s * B:(s + 1) * B] for r in res))
+                    r[s * st:(s + 1) * st] for r, st in zip(res, strides)))
             n_pairs = [p[2] for p in packs]
 
         for sh, (winner, coverage, ins_winner, ins_emit, ins_cov, ok), nP \

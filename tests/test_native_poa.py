@@ -1,0 +1,104 @@
+"""Native C++ POA engine vs the pure-Python oracle: consensuses must be
+byte-identical (same graph semantics, same tie-breaks everywhere).
+Reference analog: racon's CPU path IS spoa, so there is exactly one CPU
+consensus answer (src/window.cpp:65-142); our native engine replicates the
+Python engine the goldens were recorded with."""
+
+import random
+
+import pytest
+
+from racon_tpu import native
+from racon_tpu.core.backends import NativePoaConsensus, PythonPoaConsensus
+from racon_tpu.core.window import Window, WindowType
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+BASES = b"ACGT"
+
+
+def mutate(rng, seq, err):
+    out = bytearray()
+    for ch in seq:
+        r = rng.random()
+        if r < err * 0.5:
+            out.append(rng.choice(BASES))
+        elif r < err * 0.75:
+            pass
+        elif r < err:
+            out.append(ch)
+            out.append(rng.choice(BASES))
+        else:
+            out.append(ch)
+    return bytes(out)
+
+
+def random_window(rng, rank, wtype, with_quality, depth, blen=120):
+    truth = bytes(rng.choice(BASES) for _ in range(blen))
+    backbone = mutate(rng, truth, 0.1)
+    if not backbone:
+        backbone = b"A"
+    win = Window(0, rank, wtype, backbone, b"!" * len(backbone))
+    for _ in range(depth):
+        # partial or full span
+        b = rng.randint(0, max(0, len(backbone) // 3))
+        e = rng.randint(2 * len(backbone) // 3, len(backbone) - 1)
+        if e <= b:
+            e = min(b + 1, len(backbone) - 1)
+        frag = mutate(rng, truth[b:e + 1], 0.12)
+        if not frag:
+            continue
+        qual = (bytes(rng.randint(34, 74) for _ in range(len(frag)))
+                if with_quality else None)
+        win.add_layer(frag, qual, b, e)
+    return win
+
+
+def clone(win):
+    c = Window(win.id, win.rank, win.type, win.sequences[0],
+               win.qualities[0])
+    c.sequences = list(win.sequences)
+    c.qualities = list(win.qualities)
+    c.positions = list(win.positions)
+    return c
+
+
+@pytest.mark.parametrize("wtype,with_quality,trim", [
+    (WindowType.TGS, True, True),
+    (WindowType.TGS, False, True),
+    (WindowType.TGS, True, False),
+    (WindowType.NGS, True, True),
+])
+def test_native_matches_python(wtype, with_quality, trim):
+    rng = random.Random(hash((wtype.value, with_quality, trim)) & 0xffff)
+    wins = [random_window(rng, k, wtype, with_quality,
+                          depth=rng.randint(0, 12)) for k in range(12)]
+    natives = [clone(w) for w in wins]
+
+    pflags = PythonPoaConsensus(3, -5, -4).run(wins, trim)
+    nflags = NativePoaConsensus(3, -5, -4, num_threads=4).run(natives, trim)
+
+    assert pflags == nflags
+    for a, b in zip(wins, natives):
+        assert a.consensus == b.consensus
+
+
+def test_native_matches_python_altered_scores():
+    rng = random.Random(77)
+    wins = [random_window(rng, k, WindowType.TGS, True, depth=8)
+            for k in range(6)]
+    natives = [clone(w) for w in wins]
+    pflags = PythonPoaConsensus(8, -6, -8).run(wins, True)
+    nflags = NativePoaConsensus(8, -6, -8, num_threads=2).run(natives, True)
+    assert pflags == nflags
+    for a, b in zip(wins, natives):
+        assert a.consensus == b.consensus
+
+
+def test_passthrough_below_three_sequences():
+    win = Window(0, 0, WindowType.TGS, b"ACGTACGT", b"!" * 8)
+    win.add_layer(b"ACGT", None, 0, 4)
+    flags = NativePoaConsensus(3, -5, -4).run([win], True)
+    assert flags == [False]
+    assert win.consensus == b"ACGTACGT"
