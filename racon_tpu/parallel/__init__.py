@@ -95,13 +95,16 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int):
 
 @functools.lru_cache(maxsize=None)
 def _sharded_consensus_fn(mesh: Mesh, n_windows_local: int, max_len: int,
-                          band: int, L: int, K: int):
+                          band: int, L: int, K: int, ins_theta: float,
+                          del_beta: float):
     from ..ops.poa import consensus_chain
+    import jax.numpy as jnp
 
     def local(qrp, tp, n, m, qcodes, qweights, begin, win_of,
               bcodes, bweights, blen):
         return consensus_chain(qrp, tp, n, m, qcodes, qweights, begin,
                                win_of, bcodes, bweights, blen,
+                               jnp.float32(ins_theta), jnp.float32(del_beta),
                                n_windows=n_windows_local, max_len=max_len,
                                band=band, L=L, K=K)
 
@@ -113,7 +116,8 @@ def _sharded_consensus_fn(mesh: Mesh, n_windows_local: int, max_len: int,
 
 def sharded_consensus_round(mesh: Mesh, pair_arrays, window_arrays, *,
                             n_windows_local: int, max_len: int, band: int,
-                            L: int, K: int):
+                            L: int, K: int, ins_theta: float,
+                            del_beta: float):
     """One consensus pass (align + vote + winners) over a co-sharded batch.
 
     ``pair_arrays`` = (qrp, tp, n, m, qcodes, qweights, begin, win_of) with
@@ -125,5 +129,6 @@ def sharded_consensus_round(mesh: Mesh, pair_arrays, window_arrays, *,
     needed.  Returns ``(winner, coverage, ins_winner, ins_emit, ins_cov,
     ok)`` stacked the same way.
     """
-    fn = _sharded_consensus_fn(mesh, n_windows_local, max_len, band, L, K)
+    fn = _sharded_consensus_fn(mesh, n_windows_local, max_len, band, L, K,
+                               ins_theta, del_beta)
     return fn(*pair_arrays, *window_arrays)

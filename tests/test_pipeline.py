@@ -76,6 +76,27 @@ def test_consensus_fasta_paf_golden(data_dir):
 
 
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_quality(data_dir):
+    """Device (TpuPoaConsensus) pipeline quality: like the reference's CUDA
+    goldens, the accelerated engine records its own target — recorded 1384
+    on real TPU v5e vs CPU 1324 (reference: cudapoa 1385 vs spoa 1312,
+    ``test/racon_test.cpp:312``). On the CPU XLA backend used by tests the
+    scatter order differs slightly, so assert the quality band rather than
+    the exact chip golden."""
+    p = create_polisher(str(data_dir / "sample_reads.fastq.gz"),
+                        str(data_dir / "sample_overlaps.paf.gz"),
+                        str(data_dir / "sample_layout.fasta.gz"),
+                        num_threads=8, consensus_backend="tpu")
+    p.initialize()
+    engine = p.consensus
+    (polished,) = p.polish(True)
+    # the quality band must come from the device path, not CPU fallback
+    assert engine.stats["device_windows"] > 90, engine.stats
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d <= 1500  # real-TPU golden: 1384; CPU golden: 1324
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
 def test_consensus_window_1000(data_dir):
     (polished,) = polish(data_dir, "sample_reads.fastq.gz",
                          "sample_overlaps.paf.gz", window_length=1000)
