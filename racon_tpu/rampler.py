@@ -1,0 +1,129 @@
+"""rampler: standalone sequence subsampler / splitter (L6 companion tool).
+
+Re-creates the observable CLI contract of the reference's vendored
+``rampler`` as used by ``racon_wrapper`` (``scripts/racon_wrapper.py:58-59,
+83-84`` of the reference tree):
+
+- ``rampler -o DIR subsample <sequences> <reference_length> <coverage>``
+  writes ``DIR/<basename>_<coverage>x.<ext>`` with a random subset of
+  sequences totalling ~reference_length x coverage bases;
+- ``rampler -o DIR split <sequences> <chunk_size>`` writes
+  ``DIR/<basename>_<i>.<ext>`` chunks whose sequence bytes stay under
+  ``chunk_size`` each (input order preserved).
+
+Outputs are uncompressed FASTA, or FASTQ when the input records carry
+qualities. Subsampling is deterministic by default (``--seed``, default 0)
+so wrapper runs are reproducible; pass a different seed for new samples.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import random
+from typing import List
+
+from .io import parsers
+
+
+def _base_and_ext(path: str, has_quality: bool):
+    base = os.path.basename(path).split(".")[0]
+    return base, (".fastq" if has_quality else ".fasta")
+
+
+def _write(records, path: str) -> None:
+    with open(path, "wb") as f:
+        for rec in records:
+            if rec.quality is not None:
+                f.write(b"@" + rec.name + b"\n" + rec.data + b"\n+\n"
+                        + rec.quality + b"\n")
+            else:
+                f.write(b">" + rec.name + b"\n" + rec.data + b"\n")
+
+
+def _load(path: str):
+    parse = parsers.sequence_parser_for(path)
+    if parse is None:
+        print(f"[rampler::] error: file {path} has unsupported format",
+              file=sys.stderr)
+        sys.exit(1)
+    return list(parse(path))
+
+
+def subsample(sequences_path: str, reference_length: int, coverage: int,
+              out_dir: str, seed: int = 0) -> str:
+    records = _load(sequences_path)
+    target = reference_length * coverage
+    order = list(range(len(records)))
+    random.Random(seed).shuffle(order)
+    picked: List[int] = []
+    total = 0
+    for i in order:
+        if total >= target:
+            break
+        picked.append(i)
+        total += len(records[i].data)
+    picked.sort()  # keep input order inside the sample
+    has_quality = any(records[i].quality is not None for i in picked)
+    base, ext = _base_and_ext(sequences_path, has_quality)
+    out_path = os.path.join(out_dir, f"{base}_{coverage}x{ext}")
+    _write((records[i] for i in picked), out_path)
+    return out_path
+
+
+def split(sequences_path: str, chunk_size: int, out_dir: str) -> List[str]:
+    records = _load(sequences_path)
+    has_quality = any(r.quality is not None for r in records)
+    base, ext = _base_and_ext(sequences_path, has_quality)
+    out_paths: List[str] = []
+    chunk: List = []
+    chunk_bytes = 0
+    for rec in records:
+        if chunk and chunk_bytes + len(rec.data) > chunk_size:
+            path = os.path.join(out_dir, f"{base}_{len(out_paths)}{ext}")
+            _write(chunk, path)
+            out_paths.append(path)
+            chunk, chunk_bytes = [], 0
+        chunk.append(rec)
+        chunk_bytes += len(rec.data)
+    if chunk:
+        path = os.path.join(out_dir, f"{base}_{len(out_paths)}{ext}")
+        _write(chunk, path)
+        out_paths.append(path)
+    return out_paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="rampler",
+        description="sampling module for raw de novo DNA assembly of long "
+                    "uncorrected reads")
+    p.add_argument("-o", "--out-directory", default=".",
+                   help="path in which sampled files will be created")
+    p.add_argument("--seed", type=int, default=0,
+                   help="subsampling RNG seed (deterministic by default)")
+    sub = p.add_subparsers(dest="mode", required=True)
+
+    ps = sub.add_parser("subsample", help="subsample sequences to coverage")
+    ps.add_argument("sequences")
+    ps.add_argument("reference_length", type=int)
+    ps.add_argument("coverage", type=int)
+
+    pp = sub.add_parser("split", help="split sequences into byte chunks")
+    pp.add_argument("sequences")
+    pp.add_argument("chunk_size", type=int)
+
+    args = p.parse_args(argv)
+    os.makedirs(args.out_directory, exist_ok=True)
+
+    if args.mode == "subsample":
+        subsample(args.sequences, args.reference_length, args.coverage,
+                  args.out_directory, args.seed)
+    else:
+        split(args.sequences, args.chunk_size, args.out_directory)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
