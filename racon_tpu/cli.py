@@ -103,6 +103,9 @@ def main(argv=None) -> int:
             num_threads=args.threads,
             aligner_backend="tpu" if args.tpualigner_batches > 0 else "auto",
             consensus_backend="tpu" if args.tpupoa_batches > 0 else "auto",
+            aligner_batches=max(1, args.tpualigner_batches),
+            consensus_batches=max(1, args.tpupoa_batches),
+            banded=args.tpu_banded_alignment,
         )
     except (ValueError, ImportError) as e:
         print(f"[racon::createPolisher] error: {e}", file=sys.stderr)

@@ -95,7 +95,7 @@ def CpuPoaConsensus(match: int, mismatch: int, gap: int,
     return PythonPoaConsensus(match, mismatch, gap, num_threads)
 
 
-def make_aligner(backend: str, num_threads: int):
+def make_aligner(backend: str, num_threads: int, num_batches: int = 1):
     if backend == "python":
         return PythonAligner()
     if backend in ("native", "cpu"):
@@ -106,7 +106,8 @@ def make_aligner(backend: str, num_threads: int):
         except ImportError as e:
             raise ValueError(f"TPU aligner backend unavailable: {e}")
         return TpuAligner(fallback=NativeAligner(num_threads)
-                          if native.available() else PythonAligner())
+                          if native.available() else PythonAligner(),
+                          num_batches=num_batches)
     if backend == "auto":
         if native.available():
             return NativeAligner(num_threads)
@@ -115,7 +116,8 @@ def make_aligner(backend: str, num_threads: int):
 
 
 def make_consensus(backend: str, match: int, mismatch: int, gap: int,
-                   num_threads: int = 1):
+                   num_threads: int = 1, num_batches: int = 1,
+                   banded: bool = False):
     if backend == "python":
         return PythonPoaConsensus(match, mismatch, gap, num_threads)
     if backend in ("native", "cpu"):
@@ -124,10 +126,14 @@ def make_consensus(backend: str, match: int, mismatch: int, gap: int,
         return CpuPoaConsensus(match, mismatch, gap, num_threads)
     if backend == "tpu":
         try:
-            from ..ops.poa import TpuPoaConsensus
+            from ..ops.poa import BAND, TpuPoaConsensus
         except ImportError as e:
             raise ValueError(f"TPU consensus backend unavailable: {e}")
+        # -b halves the alignment band (the reference's banded-cudapoa
+        # speed/accuracy trade, src/main.cpp:124-126)
         return TpuPoaConsensus(match, mismatch, gap,
                                fallback=CpuPoaConsensus(match, mismatch, gap,
-                                                        num_threads))
+                                                        num_threads),
+                               band=BAND // 2 if banded else BAND,
+                               num_batches=num_batches)
     raise ValueError(f"unknown consensus backend {backend!r}")

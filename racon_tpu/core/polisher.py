@@ -41,9 +41,15 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     error_threshold: float = 0.3, trim: bool = True,
                     match: int = 3, mismatch: int = -5, gap: int = -4,
                     num_threads: int = 1, aligner_backend: str = "auto",
-                    consensus_backend: str = "auto") -> "Polisher":
+                    consensus_backend: str = "auto", aligner_batches: int = 1,
+                    consensus_batches: int = 1,
+                    banded: bool = False) -> "Polisher":
     """Factory with the reference's validation rules
-    (``polisher.cpp:62-133``)."""
+    (``polisher.cpp:62-133``). ``aligner_batches``/``consensus_batches``
+    are the accelerator batch counts (reference ``-c N`` /
+    ``--cudaaligner-batches N``, ``cudapolisher.cpp:91,215-228``) — here
+    the device pipeline depth, with the memory budget split per batch;
+    ``banded`` is the reference's ``-b`` POA banding approximation."""
     if not isinstance(type_, PolisherType):
         raise ValueError("invalid polisher type")
     if window_length <= 0:
@@ -60,14 +66,16 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     return Polisher(sequences_path, overlaps_path, target_path, type_,
                     window_length, quality_threshold, error_threshold, trim,
                     match, mismatch, gap, num_threads, aligner_backend,
-                    consensus_backend)
+                    consensus_backend, aligner_batches, consensus_batches,
+                    banded)
 
 
 class Polisher:
     def __init__(self, sequences_path, overlaps_path, target_path, type_,
                  window_length, quality_threshold, error_threshold, trim,
                  match, mismatch, gap, num_threads,
-                 aligner_backend="auto", consensus_backend="auto"):
+                 aligner_backend="auto", consensus_backend="auto",
+                 aligner_batches=1, consensus_batches=1, banded=False):
         self.sequences_path = sequences_path
         self.overlaps_path = overlaps_path
         self.target_path = target_path
@@ -78,9 +86,12 @@ class Polisher:
         self.trim = trim
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.num_threads = num_threads
-        self.aligner = make_aligner(aligner_backend, num_threads)
+        self.aligner = make_aligner(aligner_backend, num_threads,
+                                    num_batches=aligner_batches)
         self.consensus = make_consensus(consensus_backend, match, mismatch,
-                                        gap, num_threads)
+                                        gap, num_threads,
+                                        num_batches=consensus_batches,
+                                        banded=banded)
         self.logger = Logger()
 
         self.sequences: List[Sequence] = []

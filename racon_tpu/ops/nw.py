@@ -228,11 +228,18 @@ class TpuAligner:
     """
 
     def __init__(self, fallback=None, buckets=BUCKETS,
-                 max_dirs_bytes=MAX_DIRS_BYTES, mesh=None):
+                 max_dirs_bytes=MAX_DIRS_BYTES, mesh=None,
+                 num_batches: int = 1):
         self.fallback = fallback
         self.buckets = buckets
         self.max_dirs_bytes = max_dirs_bytes
         self.mesh = mesh
+        # Batch count (reference --cudaaligner-batches N,
+        # cudapolisher.cpp:91): the device pipeline depth. N chunks are
+        # kept in flight (JAX async dispatch), each capped at 1/N of the
+        # direction-matrix memory budget, so host packing of chunk k+1
+        # overlaps device compute of chunk k.
+        self.num_batches = max(1, num_batches)
         self.stats = {"device": 0, "fallback_length": 0, "fallback_band": 0,
                       "band_escalated": 0}
 
@@ -282,7 +289,8 @@ class TpuAligner:
             bi = min(by_bucket)
             indices = by_bucket.pop(bi)
             max_len, band = self.buckets[bi]
-            raw_cap = self.max_dirs_bytes // (max_len * (band // 4))
+            raw_cap = (self.max_dirs_bytes // self.num_batches
+                       ) // (max_len * (band // 4))
             # chunks pad to mesh_size * 2^k (see _pad_batch), so cap at the
             # largest such size to keep the memory bound honest
             from ..parallel import mesh_size
@@ -290,9 +298,20 @@ class TpuAligner:
             while batch_cap * 2 <= raw_cap:
                 batch_cap *= 2
             escaped: List[int] = []
+            # pipelined dispatch: keep num_batches chunks in flight so the
+            # host packs chunk k+1 while the device computes chunk k
+            # (reference analog: per-batch fill/process loops on pool
+            # threads, cudapolisher.cpp:98-160)
+            inflight = []
             for start in range(0, len(indices), batch_cap):
                 chunk = indices[start:start + batch_cap]
-                self._run_chunk(pairs, chunk, max_len, band, cigars, escaped)
+                inflight.append(self._launch_chunk(pairs, chunk,
+                                                   max_len, band))
+                if len(inflight) >= self.num_batches:
+                    self._finish_chunk(inflight.pop(0), band, cigars,
+                                       escaped)
+            while inflight:
+                self._finish_chunk(inflight.pop(0), band, cigars, escaped)
             for idx in escaped:
                 q, t = pairs[idx]
                 nbi = self._bucket_index(len(q), len(t), bi + 1)
@@ -312,7 +331,10 @@ class TpuAligner:
                 cigars[i] = cig
         return cigars
 
-    def _run_chunk(self, pairs, chunk, max_len, band, cigars, reject):
+    def _launch_chunk(self, pairs, chunk, max_len, band):
+        """Pack a chunk and dispatch its kernels; returns the in-flight
+        handle consumed by ``_finish_chunk``. Device work proceeds
+        asynchronously after dispatch."""
         # Pad the batch to a power of two: B is part of the compiled shape,
         # so arbitrary batch sizes would recompile the kernels every call.
         B = self._pad_batch(len(chunk))
@@ -337,6 +359,10 @@ class TpuAligner:
         else:
             out = align_chain(jnp.asarray(qrp), jnp.asarray(tp), nd, md,
                               max_len=max_len, band=band)
+        return chunk, pairs, n, m, out
+
+    def _finish_chunk(self, launched, band, cigars, reject):
+        chunk, pairs, n, m, out = launched
         ops_packed, score, fi, fj = jax.device_get(out)
         # unpack 4 codes/byte -> [B, 2L] uint8
         shifts = np.array([0, 2, 4, 6], dtype=np.uint8)

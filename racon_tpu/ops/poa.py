@@ -233,7 +233,8 @@ class TpuPoaConsensus:
 
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
                  max_depth: int = 200, band: int = BAND, rounds: int = 5,
-                 mesh=None, ins_theta: float = 0.25, del_beta: float = 0.6):
+                 mesh=None, ins_theta: float = 0.25, del_beta: float = 0.6,
+                 num_batches: int = 1):
         # match/mismatch/gap kept for interface parity; the pileup engine
         # votes by base weight rather than alignment score.
         self.fallback = fallback
@@ -243,6 +244,11 @@ class TpuPoaConsensus:
         self.mesh = mesh
         self.ins_theta = ins_theta
         self.del_beta = del_beta
+        # Batch count (reference -c N, cudapolisher.cpp:215-228): windows
+        # are LPT-split into N groups per refinement round, all dispatched
+        # before the first result is fetched, so host packing overlaps
+        # device compute.
+        self.num_batches = max(1, num_batches)
         self.stats = {"device_windows": 0, "fallback_windows": 0,
                       "dropped_layers": 0, "passthrough": 0}
 
@@ -370,11 +376,24 @@ class TpuPoaConsensus:
     def _device_round(self, live, L, Lq) -> None:
         """One align+vote+consensus pass; updates each _Work in place.
 
-        With a mesh, windows are LPT-binned into one shard per device
-        (pairs of a window never cross shards, so votes stay shard-local)
-        and all shards run in one ``shard_map`` call; without one, the
-        whole batch is a single shard on the default device.
-        """
+        Windows are LPT-split into ``num_batches`` groups, every group's
+        kernels are dispatched before the first group's results are
+        fetched (JAX async dispatch), and results apply in order."""
+        from ..parallel import partition_balanced
+        if self.num_batches == 1:
+            groups = [list(live)]
+        else:
+            bins = partition_balanced([len(w.layers) for _, w in live],
+                                      self.num_batches)
+            groups = [[live[i] for i in b] for b in bins if b]
+        launches = [self._launch_group(g, L, Lq) for g in groups]
+        for launch in launches:
+            self._finish_group(launch)
+
+    def _launch_group(self, live, L, Lq):
+        """Pack one window group (per-mesh-shard when a mesh is set — pairs
+        of a window never cross shards, so votes stay shard-local) and
+        dispatch its align+vote+consensus kernels without blocking."""
         from ..parallel import (mesh_size, partition_balanced,
                                 sharded_consensus_round)
         band = self.band
@@ -403,9 +422,6 @@ class TpuPoaConsensus:
                 *(jnp.asarray(a) for a in window_arrays),
                 jnp.float32(self.ins_theta), jnp.float32(self.del_beta),
                 n_windows=nWp, max_len=Lq, band=band, L=L, K=K_INS)
-            res = jax.device_get(out)
-            shard_results = [tuple(np.asarray(x) for x in res)]
-            n_pairs = [nP]
         else:
             pair_stk = [np.concatenate([p[0][a] for p in packs])
                         for a in range(8)]
@@ -417,14 +433,19 @@ class TpuPoaConsensus:
                 tuple(jnp.asarray(a) for a in win_stk),
                 n_windows_local=nWp, max_len=Lq, band=band, L=L, K=K_INS,
                 ins_theta=self.ins_theta, del_beta=self.del_beta)
-            res = [np.asarray(x) for x in jax.device_get(out)]
-            # fixed output order: five window-major arrays, then pair-major ok
-            strides = (nWp, nWp, nWp, nWp, nWp, B)
-            shard_results = []
-            for s in range(nd):
-                shard_results.append(tuple(
-                    r[s * st:(s + 1) * st] for r, st in zip(res, strides)))
-            n_pairs = [p[2] for p in packs]
+        n_pairs = [p[2] for p in packs]
+        return shards, out, n_pairs, B, nWp, nd
+
+    def _finish_group(self, launch) -> None:
+        """Fetch one launched group's results and apply them in place."""
+        shards, out, n_pairs, B, nWp, nd = launch
+        res = [np.asarray(x) for x in jax.device_get(out)]
+        # fixed output order: five window-major arrays, then pair-major ok
+        strides = (nWp, nWp, nWp, nWp, nWp, B)
+        shard_results = []
+        for s in range(nd):
+            shard_results.append(tuple(
+                r[s * st:(s + 1) * st] for r, st in zip(res, strides)))
 
         for sh, (winner, coverage, ins_winner, ins_emit, ins_cov, ok), nP \
                 in zip(shards, shard_results, n_pairs):

@@ -1,0 +1,68 @@
+"""Accelerator knob tests: -b (banded), -c N / --tpualigner-batches N
+(batch counts = device pipeline depth + per-batch memory split).
+Reference: src/main.cpp:111-126, cudapolisher.cpp:91,215-228."""
+
+import numpy as np
+
+from racon_tpu.cli import build_parser, _preprocess_argv
+from racon_tpu.core.backends import make_aligner, make_consensus
+from racon_tpu.core.window import Window, WindowType
+from racon_tpu.ops.nw import TpuAligner
+from racon_tpu.ops.poa import BAND, TpuPoaConsensus
+
+from test_parallel import _random_pairs, _random_windows
+
+
+def test_cli_optional_c_argument():
+    args = build_parser().parse_args(_preprocess_argv(
+        ["-c", "2", "a.fasta", "b.paf", "c.fasta"]))
+    assert args.tpupoa_batches == 2
+    args = build_parser().parse_args(_preprocess_argv(
+        ["-c", "a.fasta", "b.paf", "c.fasta"]))
+    assert args.tpupoa_batches == 1
+    args = build_parser().parse_args(_preprocess_argv(
+        ["a.fasta", "b.paf", "c.fasta"]))
+    assert args.tpupoa_batches == 0
+
+
+def test_banded_flag_halves_consensus_band():
+    eng = make_consensus("tpu", 3, -5, -4, banded=True)
+    assert eng.band == BAND // 2
+    eng = make_consensus("tpu", 3, -5, -4, banded=False)
+    assert eng.band == BAND
+
+
+def test_batch_counts_reach_engines():
+    aligner = make_aligner("tpu", 1, num_batches=4)
+    assert aligner.num_batches == 4
+    consensus = make_consensus("tpu", 3, -5, -4, num_batches=3)
+    assert consensus.num_batches == 3
+
+
+def test_aligner_batches_do_not_change_results():
+    pairs = _random_pairs(50, seed=13)
+    one = TpuAligner(buckets=((256, 128),), num_batches=1,
+                     max_dirs_bytes=256 * 128 * 64)  # force several chunks
+    three = TpuAligner(buckets=((256, 128),), num_batches=3,
+                       max_dirs_bytes=256 * 128 * 64)
+    assert one.align_batch(pairs) == three.align_batch(pairs)
+    assert three.stats["device"] == len(pairs)
+
+
+def test_consensus_batches_do_not_change_results():
+    wins_a = _random_windows(11, seed=31)
+    wins_b = _random_windows(11, seed=31)
+    TpuPoaConsensus(3, -5, -4, band=64, rounds=2, num_batches=1).run(
+        wins_a, True)
+    eng = TpuPoaConsensus(3, -5, -4, band=64, rounds=2, num_batches=3)
+    eng.run(wins_b, True)
+    assert [w.consensus for w in wins_a] == [w.consensus for w in wins_b]
+    assert eng.stats["device_windows"] == len(wins_b)
+
+
+def test_banded_consensus_still_polishes():
+    wins = _random_windows(6, seed=41)
+    eng = TpuPoaConsensus(3, -5, -4, band=64, rounds=2)
+    flags = eng.run(wins, True)
+    assert all(flags)
+    assert all(len(w.consensus) > 0 for w in wins)
