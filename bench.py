@@ -3,7 +3,8 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``value`` is the TPU consensus engine's warm windows/sec over the real
-λ-phage polishing workload (1 contig, ~1160 windows of w=500 at ~30x);
+λ-phage polishing workload (1 contig of 47.5 kbp → 96 windows of w=500 at
+~30x);
 ``vs_baseline`` is the speedup over the CPU spoa-equivalent engine on the
 same windows (the reference's own accelerated-vs-CPU framing — it publishes
 no absolute numbers, BASELINE.md). Extra diagnostic fields ride along in

@@ -12,7 +12,7 @@ built TPU-first:
 - ``racon_tpu.models``   — CPU reference algorithms: pairwise NW alignment and
   partial-order-alignment consensus with spoa-faithful semantics (reference:
   vendored ``edlib`` / ``spoa``).
-- ``racon_tpu.ops``      — JAX/XLA/Pallas batched kernels: wavefront NW with
+- ``racon_tpu.ops``      — JAX/XLA batched kernels: wavefront NW with
   traceback and batched POA over fixed-shape window batches (reference:
   ``cudaaligner`` / ``cudapoa`` SDK usage in ``src/cuda/``).
 - ``racon_tpu.parallel`` — device-mesh dispatch (`shard_map` over windows =

@@ -54,8 +54,13 @@ class PythonPoaConsensus:
         self.engine = PoaAlignmentEngine(match, mismatch, gap)
         self.num_threads = num_threads
 
-    def run(self, windows, trim: bool) -> List[bool]:
-        return [w.generate_consensus(self.engine, trim) for w in windows]
+    def run(self, windows, trim: bool, progress=None) -> List[bool]:
+        flags: List[bool] = []
+        for k, w in enumerate(windows):
+            flags.append(w.generate_consensus(self.engine, trim))
+            if progress is not None:
+                progress(k + 1, len(windows))
+        return flags
 
 
 class NativePoaConsensus:
@@ -72,17 +77,25 @@ class NativePoaConsensus:
         self.num_threads = num_threads
         self.engine = PoaAlignmentEngine(match, mismatch, gap)
 
-    def run(self, windows, trim: bool) -> List[bool]:
-        results = native.poa_consensus_batch(
-            windows, trim, self.match, self.mismatch, self.gap,
-            self.num_threads)
+    def run(self, windows, trim: bool, progress=None) -> List[bool]:
         flags: List[bool] = []
-        for w, (consensus, polished, failed) in zip(windows, results):
-            if failed:
-                flags.append(w.generate_consensus(self.engine, trim))
-            else:
-                w.consensus = consensus
-                flags.append(polished)
+        n = len(windows)
+        # with a progress callback, feed the native pool in 20 slices so the
+        # reference's 20-bin bar contract is observable mid-run
+        chunk = max(1, -(-n // 20)) if progress is not None else max(1, n)
+        for start in range(0, n, chunk):
+            part = windows[start:start + chunk]
+            results = native.poa_consensus_batch(
+                part, trim, self.match, self.mismatch, self.gap,
+                self.num_threads)
+            for w, (consensus, polished, failed) in zip(part, results):
+                if failed:
+                    flags.append(w.generate_consensus(self.engine, trim))
+                else:
+                    w.consensus = consensus
+                    flags.append(polished)
+            if progress is not None:
+                progress(min(start + chunk, n), n)
         return flags
 
 

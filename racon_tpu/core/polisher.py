@@ -223,19 +223,33 @@ class Polisher:
     def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
         """Align CIGAR-less overlaps (batched through the aligner backend —
         reference: ``polisher.cpp:461-483`` / ``cudapolisher.cpp:86-200``)
-        then derive per-window breaking points."""
+        then derive per-window breaking points, advancing the reference's
+        20-bin progress bar (``polisher.cpp:475-481``)."""
+        log = self.logger
+        msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
         need = [o for o in overlaps if not o.cigar and not o.breaking_points]
-        # Feed the aligner in bounded chunks so transient span copies stay
-        # O(chunk) rather than O(total reads) (reference analog: 1 GiB
-        # streaming chunks, polisher.cpp:26).
-        chunk = 1024
-        for begin in range(0, len(need), chunk):
-            part = need[begin:begin + chunk]
+        if getattr(self.aligner, "wants_full_stream", False):
+            # device backend buckets/chunks internally; hand it the whole
+            # stream so batches stay dense (it reports progress per chunk)
             pairs = [(o.query_span_bytes(self.sequences),
-                      o.target_span_bytes(self.sequences)) for o in part]
-            cigars = self.aligner.align_batch(pairs)
-            for o, cigar in zip(part, cigars):
+                      o.target_span_bytes(self.sequences)) for o in need]
+            cigars = self.aligner.align_batch(
+                pairs, progress=lambda d, t: log.bar_to(msg, d, t))
+            for o, cigar in zip(need, cigars):
                 o.cigar = cigar
+        else:
+            # host path: bounded chunks keep transient span copies O(chunk)
+            # rather than O(total reads) (reference analog: 1 GiB streaming
+            # chunks, polisher.cpp:26)
+            chunk = 1024
+            for begin in range(0, len(need), chunk):
+                part = need[begin:begin + chunk]
+                pairs = [(o.query_span_bytes(self.sequences),
+                          o.target_span_bytes(self.sequences)) for o in part]
+                cigars = self.aligner.align_batch(pairs)
+                for o, cigar in zip(part, cigars):
+                    o.cigar = cigar
+                log.bar_to(msg, begin + len(part), len(need))
         for o in overlaps:
             o.find_breaking_points(self.sequences, self.window_length)
         self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
@@ -294,7 +308,10 @@ class Polisher:
         log = self.logger
         log.log()
 
-        polished_flags = self.consensus.run(self.windows, self.trim)
+        msg = "[racon_tpu::Polisher::polish] generating consensus"
+        polished_flags = self.consensus.run(
+            self.windows, self.trim,
+            progress=lambda d, t: log.bar_to(msg, d, t))
 
         dst: List[Sequence] = []
         polished_data: List[bytes] = []
@@ -319,6 +336,7 @@ class Polisher:
                 polished_data = []
 
         log.log("[racon_tpu::Polisher::polish] generated consensus")
+        log.total("[racon_tpu::Polisher::] total =")
         self.windows = []
         self.sequences = []
         return dst

@@ -253,6 +253,7 @@ class PoaGraph:
             for e in self.in_edges[node]:
                 if (scores[node] < e.weight or
                         (scores[node] == e.weight and
+                         predecessors[node] != -1 and
                          scores[predecessors[node]] <= scores[e.src])):
                     scores[node] = e.weight
                     predecessors[node] = e.src
@@ -296,6 +297,7 @@ class PoaGraph:
                     continue
                 if (scores[nid] < e.weight or
                         (scores[nid] == e.weight and
+                         predecessors[nid] != -1 and
                          scores[predecessors[nid]] <= scores[e.src])):
                     scores[nid] = e.weight
                     predecessors[nid] = e.src

@@ -169,8 +169,11 @@ def poa_consensus_batch(windows, trim: bool, match: int, mismatch: int,
 
     result = []
     for i in range(nw):
-        data = ctypes.string_at(c_out[i], c_outlen[i])
-        lib.rt_free(c_out[i])
+        if c_out[i]:  # null under native OOM -> failed flag drives fallback
+            data = ctypes.string_at(c_out[i], c_outlen[i])
+            lib.rt_free(c_out[i])
+        else:
+            data = b""
         result.append((data, bool(c_pol[i]), bool(c_status[i])))
     return result
 

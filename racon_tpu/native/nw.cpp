@@ -309,6 +309,18 @@ std::string nw_cigar_impl(const char* q, int64_t n, const char* t, int64_t m) {
     if (i > 0) ops.append(i, 'I');
     if (j > 0) ops.append(j, 'D');
     std::reverse(ops.begin(), ops.end());
+
+    // The thread_local fill buffers live for the thread's lifetime; after a
+    // large alignment on a long-lived caller thread they would pin up to
+    // kMyersMemLimit indefinitely, so release outsized capacity here.
+    constexpr size_t kRetainBytes = 32u << 20;
+    if (ps.capacity() * sizeof(uint64_t) * 2 + ss.capacity() * sizeof(int32_t)
+        > kRetainBytes) {
+        std::vector<uint64_t>().swap(ps);
+        std::vector<uint64_t>().swap(ms);
+        std::vector<int32_t>().swap(ss);
+    }
+
     Cigar c;
     for (char op : ops) c.push(op);
     c.flush();

@@ -619,6 +619,13 @@ void rt_poa_consensus_batch(
             status_out[w] = ok ? 0 : 1;
             polished_out[w] = polished ? 1 : 0;
             char* buf = (char*)std::malloc(consensus.size() + 1);
+            if (buf == nullptr) {  // OOM: flag the window for Python fallback
+                status_out[w] = 1;
+                polished_out[w] = 0;
+                consensus_out[w] = nullptr;
+                consensus_len_out[w] = 0;
+                continue;
+            }
             std::memcpy(buf, consensus.data(), consensus.size());
             buf[consensus.size()] = '\0';
             consensus_out[w] = buf;

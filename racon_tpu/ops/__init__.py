@@ -1,4 +1,4 @@
-"""JAX/XLA/Pallas batched kernels — the TPU compute path.
+"""JAX/XLA batched kernels — the TPU compute path.
 
 - ``racon_tpu.ops.nw``  — batched banded NW direction-matrix kernel + host
   traceback (role of the reference's cudaaligner batches,

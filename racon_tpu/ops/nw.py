@@ -266,7 +266,13 @@ class TpuAligner:
                     fallback_bi = bi
         return fallback_bi
 
-    def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[str]:
+    # the polisher hands this backend the whole overlap stream (it buckets
+    # and chunks internally) instead of pre-chunked 1024-pair slices
+    wants_full_stream = True
+
+    def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]],
+                    progress=None) -> List[str]:
+        done_pairs = 0
         cigars: List[str] = [""] * len(pairs)
         by_bucket = {}
         reject: List[int] = []
@@ -295,6 +301,15 @@ class TpuAligner:
             # largest such size to keep the memory bound honest
             from ..parallel import mesh_size
             batch_cap = mesh_size(self.mesh)
+            if batch_cap > max(1, raw_cap):
+                import warnings
+                warnings.warn(
+                    f"mesh size {batch_cap} exceeds the direction-matrix "
+                    f"memory budget ({raw_cap} pairs of bucket "
+                    f"({max_len},{band}) fit in "
+                    f"{self.max_dirs_bytes // self.num_batches} bytes); "
+                    f"lower num_batches or use a smaller mesh",
+                    RuntimeWarning)
             while batch_cap * 2 <= raw_cap:
                 batch_cap *= 2
             escaped: List[int] = []
@@ -308,10 +323,16 @@ class TpuAligner:
                 inflight.append(self._launch_chunk(pairs, chunk,
                                                    max_len, band))
                 if len(inflight) >= self.num_batches:
+                    done_pairs += len(inflight[0][0])
                     self._finish_chunk(inflight.pop(0), band, cigars,
                                        escaped)
+                    if progress is not None:
+                        progress(done_pairs, len(pairs))
             while inflight:
+                done_pairs += len(inflight[0][0])
                 self._finish_chunk(inflight.pop(0), band, cigars, escaped)
+                if progress is not None:
+                    progress(done_pairs, len(pairs))
             for idx in escaped:
                 q, t = pairs[idx]
                 nbi = self._bucket_index(len(q), len(t), bi + 1)

@@ -254,7 +254,7 @@ class TpuPoaConsensus:
 
     # -------------------------------------------------------------- public
 
-    def run(self, windows, trim: bool) -> List[bool]:
+    def run(self, windows, trim: bool, progress=None) -> List[bool]:
         results: List[Optional[bool]] = [None] * len(windows)
         works: List[_Work] = []
         for i, win in enumerate(windows):
@@ -286,6 +286,9 @@ class TpuPoaConsensus:
             if not live:
                 break
             self._device_round(live, L, Lq)
+            if progress is not None:
+                # bar units = refinement rounds (+1 for stitch/fallback)
+                progress(rnd + 1, self.rounds + 1)
 
         for i, w in live:
             covs = w.covs
@@ -294,7 +297,10 @@ class TpuPoaConsensus:
                 results[i] = None
                 continue
             if w.win.type == WindowType.TGS and trim:
-                avg_cov = (w.n_seqs - 1) // 2
+                # threshold uses the *voted* depth: layers beyond max_depth
+                # never vote, so counting them would make trimming a no-op
+                # on windows deeper than ~2x max_depth
+                avg_cov = min(w.n_seqs - 1, self.max_depth) // 2
                 b_, e_ = 0, len(consensus) - 1
                 while b_ < len(consensus) and covs[b_] < avg_cov:
                     b_ += 1
@@ -315,6 +321,8 @@ class TpuPoaConsensus:
             flags = self.fallback.run([windows[i] for i in cpu_idx], trim)
             for i, f in zip(cpu_idx, flags):
                 results[i] = f
+        if progress is not None:
+            progress(self.rounds + 1, self.rounds + 1)
         return [bool(r) for r in results]
 
     # -------------------------------------------------------------- device

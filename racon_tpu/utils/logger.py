@@ -26,6 +26,7 @@ class Logger:
         self._origin = time.perf_counter()
         self._stage_start = self._origin
         self._bar_bins = 0
+        self._bar_abs = 0
 
     def log(self, message: str | None = None) -> None:
         now = time.perf_counter()
@@ -44,6 +45,19 @@ class Logger:
         if self._bar_bins == 20:
             self._bar_bins = 0
             self._stage_start = time.perf_counter()
+
+    def bar_to(self, message: str, done: int, total: int) -> None:
+        """Advance the bar to ``20 * done / total`` bins (batched pipelines
+        report chunk completions, not per-item ticks, so the bar may jump
+        several bins per call). Tracks stage progress in an absolute
+        counter: ``bar()`` itself wraps ``_bar_bins`` back to 0 at 100% for
+        the next stage, so counting emitted bins directly would loop."""
+        target = min(20, (20 * done) // max(1, total))
+        while self._bar_abs < target:
+            self._bar_abs += 1
+            self.bar(message)
+        if target >= 20:
+            self._bar_abs = 0  # stage complete; next stage starts fresh
 
     def total(self, message: str) -> None:
         now = time.perf_counter()

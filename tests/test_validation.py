@@ -1,0 +1,43 @@
+"""Factory validation tests — the reference's five death tests
+(``test/racon_test.cpp:55-86``) as ``pytest.raises`` against
+``create_polisher``: invalid polisher type, window length 0, and a bad file
+extension for each of the three inputs."""
+
+import pytest
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+
+
+@pytest.fixture
+def paths(data_dir):
+    return (str(data_dir / "sample_reads.fastq.gz"),
+            str(data_dir / "sample_overlaps.paf.gz"),
+            str(data_dir / "sample_layout.fasta.gz"))
+
+
+def test_invalid_polisher_type(paths):
+    with pytest.raises(ValueError, match="invalid polisher type"):
+        create_polisher(*paths, type_=3)  # type: ignore[arg-type]
+
+
+def test_invalid_window_length(paths):
+    with pytest.raises(ValueError, match="invalid window length"):
+        create_polisher(*paths, window_length=0)
+
+
+def test_bad_sequences_extension(paths):
+    _, overlaps, target = paths
+    with pytest.raises(ValueError, match="unsupported format extension"):
+        create_polisher("reads.txt", overlaps, target)
+
+
+def test_bad_overlaps_extension(paths):
+    seqs, _, target = paths
+    with pytest.raises(ValueError, match="unsupported format extension"):
+        create_polisher(seqs, "overlaps.txt", target)
+
+
+def test_bad_target_extension(paths):
+    seqs, overlaps, _ = paths
+    with pytest.raises(ValueError, match="unsupported format extension"):
+        create_polisher(seqs, overlaps, "layout.txt")
