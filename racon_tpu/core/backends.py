@@ -80,9 +80,11 @@ class NativePoaConsensus:
     def run(self, windows, trim: bool, progress=None) -> List[bool]:
         flags: List[bool] = []
         n = len(windows)
-        # with a progress callback, feed the native pool in 20 slices so the
-        # reference's 20-bin bar contract is observable mid-run
-        chunk = max(1, -(-n // 20)) if progress is not None else max(1, n)
+        # with a progress callback, slice the batch so the reference's
+        # 20-bin bar is observable mid-run — but never below 4 windows per
+        # pool thread, or the slices starve the native thread pool
+        chunk = (max(1, -(-n // 20), 4 * self.num_threads)
+                 if progress is not None else max(1, n))
         for start in range(0, n, chunk):
             part = windows[start:start + chunk]
             results = native.poa_consensus_batch(

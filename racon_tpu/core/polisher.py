@@ -229,14 +229,23 @@ class Polisher:
         msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
         need = [o for o in overlaps if not o.cigar and not o.breaking_points]
         if getattr(self.aligner, "wants_full_stream", False):
-            # device backend buckets/chunks internally; hand it the whole
-            # stream so batches stay dense (it reports progress per chunk)
-            pairs = [(o.query_span_bytes(self.sequences),
-                      o.target_span_bytes(self.sequences)) for o in need]
-            cigars = self.aligner.align_batch(
-                pairs, progress=lambda d, t: log.bar_to(msg, d, t))
-            for o, cigar in zip(need, cigars):
-                o.cigar = cigar
+            # device backend buckets/chunks internally; hand it a large
+            # slice so batches stay dense, but still bound the transient
+            # span copies (2x aligned bases of duplicated host bytes if
+            # unbounded — reference analog: 1 GiB streaming chunks,
+            # polisher.cpp:26)
+            chunk = 65536
+            for begin in range(0, len(need), chunk):
+                part = need[begin:begin + chunk]
+                pairs = [(o.query_span_bytes(self.sequences),
+                          o.target_span_bytes(self.sequences)) for o in part]
+                base = begin
+                cigars = self.aligner.align_batch(
+                    pairs,
+                    progress=lambda d, t: log.bar_to(msg, base + d,
+                                                     len(need)))
+                for o, cigar in zip(part, cigars):
+                    o.cigar = cigar
         else:
             # host path: bounded chunks keep transient span copies O(chunk)
             # rather than O(total reads) (reference analog: 1 GiB streaming

@@ -163,14 +163,14 @@ def _walk_op(pk, i, j, *, c, RB, S, U):
 
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band"))
-def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
+def _walk_ops_kernel(packed, n, m, *, max_len: int, band: int):
     """On-device traceback: vmapped pointer chase over the packed direction
     matrix (which never leaves HBM — downloading it dominated wall-clock
     otherwise). Emits one op code per step, consumed backwards from (n, m):
-    0=M, 1=I, 2=D, 3=done, 4=band escape. Exactly n+m real steps per pair.
-    Output ops are packed 4-per-byte and returned together with the score
-    so one host round-trip fetches everything (the tunnel to the device has
-    ~0.2s per-transfer latency).
+    0=M, 1=I, 2=D, 3=done-or-band-escape. Exactly n+m real steps per pair
+    (a band escape stalls the walk, leaving the final ``(fi, fj) != 0``).
+    Returns unpacked ``(ops [B, 2L] u8, fi, fj)`` — stays on device for the
+    consensus vote path; the aligner packs via :func:`_traceback_kernel`.
     """
     L, W = max_len, band
     c = W // 2
@@ -188,8 +188,17 @@ def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
         (fi, fj), ops = lax.scan(step, (nn, mm), None, length=2 * L)
         return ops, fi, fj
 
-    ops, fi, fj = jax.vmap(per_pair)(flat, n, m)
-    # 2-bit codes, 4 per byte, fetched in one host round-trip
+    return jax.vmap(per_pair)(flat, n, m)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
+    """Aligner-facing traceback: walks on device, then packs the op codes
+    2-bit x 4-per-byte so one host round-trip fetches everything (the
+    tunnel to the device has ~0.2s per-transfer latency)."""
+    L = max_len
+    B = packed.shape[0]
+    ops, fi, fj = _walk_ops_kernel(packed, n, m, max_len=max_len, band=band)
     o4 = ops.reshape(B, (2 * L) // 4, 4)
     ops_packed = (o4[:, :, 0] | (o4[:, :, 1] << 2) | (o4[:, :, 2] << 4)
                   | (o4[:, :, 3] << 6))
@@ -272,6 +281,9 @@ class TpuAligner:
 
     def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]],
                     progress=None) -> List[str]:
+        # progress counts pairs whose final CIGAR is settled — escaped pairs
+        # re-enter a wider bucket and are only counted once, on their last
+        # visit; fallback/empty pairs are counted when resolved
         done_pairs = 0
         cigars: List[str] = [""] * len(pairs)
         by_bucket = {}
@@ -280,6 +292,7 @@ class TpuAligner:
             if len(q) == 0 or len(t) == 0:
                 cigars[idx] = (f"{len(t)}D" if len(t) else
                                (f"{len(q)}I" if len(q) else ""))
+                done_pairs += 1
                 continue
             bi = self._bucket_index(len(q), len(t))
             if bi is None:
@@ -323,14 +336,18 @@ class TpuAligner:
                 inflight.append(self._launch_chunk(pairs, chunk,
                                                    max_len, band))
                 if len(inflight) >= self.num_batches:
-                    done_pairs += len(inflight[0][0])
+                    n_chunk = len(inflight[0][0])
+                    n_esc = len(escaped)
                     self._finish_chunk(inflight.pop(0), band, cigars,
                                        escaped)
+                    done_pairs += n_chunk - (len(escaped) - n_esc)
                     if progress is not None:
                         progress(done_pairs, len(pairs))
             while inflight:
-                done_pairs += len(inflight[0][0])
+                n_chunk = len(inflight[0][0])
+                n_esc = len(escaped)
                 self._finish_chunk(inflight.pop(0), band, cigars, escaped)
+                done_pairs += n_chunk - (len(escaped) - n_esc)
                 if progress is not None:
                     progress(done_pairs, len(pairs))
             for idx in escaped:
@@ -350,6 +367,8 @@ class TpuAligner:
             fb = self.fallback.align_batch([pairs[i] for i in reject])
             for i, cig in zip(reject, fb):
                 cigars[i] = cig
+        if progress is not None and done_pairs < len(pairs):
+            progress(len(pairs), len(pairs))
         return cigars
 
     def _launch_chunk(self, pairs, chunk, max_len, band):

@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .nw import _nw_wavefront_kernel, _walk_op
+from .nw import _nw_wavefront_kernel, _walk_ops_kernel
 from ..core.window import WindowType
 
 # Alignment band for layer-vs-backbone-span alignment (layers are ~window
@@ -73,59 +73,64 @@ MAX_PAIR_DIRS_BYTES = 1024 * 1024 * 1024
 
 @functools.partial(jax.jit,
                    static_argnames=("max_len", "band", "L", "K", "n_windows"))
-def _vote_kernel(packed, score, n, m, qcodes, qweights, begin, win_of,
-                 *, n_windows: int, max_len: int, band: int, L: int, K: int):
-    """Walk every alignment backwards on device and scatter weighted votes.
+def _vote_from_ops(ops, fi, fj, score, n, m, qcodes, qweights, begin, win_of,
+                   *, n_windows: int, max_len: int, band: int, L: int, K: int):
+    """Turn walked op codes into scatter-added weighted votes — vectorized.
 
-    packed: uint8 [B, 2*max_len, band/8] direction matrix (from the NW
-    kernel); qcodes/qweights: [B, max_len] layer base codes and weights;
-    begin: [B] backbone-span start column; win_of: [B] owning window index.
+    ops: uint8 [B, S] backward-walk op codes from ``_walk_ops_kernel``
+    (0=M, 1=I, 2=D, >=3 done/stalled); qcodes/qweights: [B, max_len] layer
+    base codes and weights; begin: [B] backbone-span start column; win_of:
+    [B] owning window index.
+
+    The walk position *before* step t is recovered with prefix sums of the
+    consumed-query/-target indicators (no sequential re-walk), the
+    insertion-run length with a prefix max over the last non-insertion
+    step, and the layer base/weight lookups are one batched gather each —
+    everything is [B, S] elementwise work, which XLA fuses into a handful
+    of passes instead of S tiny scan steps.
 
     Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted same-shape
     i32, ok [B] bool). Vote layout: column votes at col*CH+ch, insertion
     slot s of junction col at (L + col*K + s)*CH + ch.
     """
-    W = band
-    c = W // 2
+    B, S = ops.shape
     Lq = max_len
-    RB = W // 8
-    B = packed.shape[0]
-    S = 2 * Lq
     VOT = L * (1 + K) * CH
-    flat = packed.reshape(B, S * RB)
 
-    def per_pair(pk, nn, mm, qc, qw, bg):
-        def step(carry, _):
-            i, j, ins_run = carry
-            op, di, dj = _walk_op(pk, i, j, c=c, RB=RB, S=S, U=W // 2)
-            op = op.astype(jnp.int32)
+    is_M = ops == 0
+    is_I = ops == 1
+    is_D = ops == 2
+    di = (is_M | is_I).astype(jnp.int32)   # consumed a query base
+    dj = (is_M | is_D).astype(jnp.int32)   # consumed a target base
+    # position before step t: (n, m) minus everything consumed earlier
+    i_t = n[:, None] - jnp.cumsum(di, axis=1) + di
+    j_t = m[:, None] - jnp.cumsum(dj, axis=1) + dj
 
-            base = jnp.take(qc, jnp.clip(i - 1, 0, Lq - 1)).astype(jnp.int32)
-            wgt = jnp.take(qw, jnp.clip(i - 1, 0, Lq - 1)).astype(jnp.float32)
-            col = bg + j - 1
-            # vote target: M -> (col, base); D -> (col, DEL); I -> ins slot
-            slot = jnp.minimum(ins_run, K - 1)
-            idx = jnp.where(
-                op == 0, col * CH + base,
-                jnp.where(op == 2, col * CH + DEL,
-                          (L + col * K + slot) * CH + base))
-            valid = (op < 3) & (j >= 1) & (col >= 0) & (col < L)
-            idx = jnp.where(valid, idx, VOT)  # sink
-            w = jnp.where(valid, wgt, 0.0)
+    # ins_run at t = number of consecutive I steps immediately before t
+    t_idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    last_ni = lax.cummax(jnp.where(~is_I, t_idx, -1), axis=1)
+    last_ni_excl = jnp.concatenate(
+        [jnp.full((B, 1), -1, jnp.int32), last_ni[:, :-1]], axis=1)
+    ins_run = t_idx - 1 - last_ni_excl
+    slot = jnp.minimum(ins_run, K - 1)
 
-            ins_run = jnp.where(op == 1, ins_run + 1, 0)
-            return (i - di, j - dj, ins_run), (idx, w)
+    qpos = jnp.clip(i_t - 1, 0, Lq - 1)
+    base = jnp.take_along_axis(qcodes, qpos, axis=1).astype(jnp.int32)
+    wgt = jnp.take_along_axis(qweights, qpos, axis=1).astype(jnp.float32)
+    col = begin[:, None] + j_t - 1
+    # vote target: M -> (col, base); D -> (col, DEL); I -> ins slot
+    idx = jnp.where(
+        is_M, col * CH + base,
+        jnp.where(is_D, col * CH + DEL,
+                  (L + col * K + slot) * CH + base))
+    valid = (ops < 3) & (j_t >= 1) & (col >= 0) & (col < L)
+    idx = jnp.where(valid, idx, VOT)  # sink
+    w = jnp.where(valid, wgt, 0.0)
 
-        (fi, fj, _), (idxs, ws) = lax.scan(
-            step, (nn, mm, jnp.int32(0)), None, length=S)
-        ok = (fi == 0) & (fj == 0)
-        return idxs, ws, ok
+    ok = (fi == 0) & (fj == 0) & (score < (band // 2))
+    wsv = w * ok[:, None].astype(jnp.float32)
 
-    idxs, ws, ok = jax.vmap(per_pair)(flat, n, m, qcodes, qweights, begin)
-    ok = ok & (score < (band // 2))
-    wsv = ws * ok[:, None].astype(jnp.float32)
-
-    flat_idx = (win_of[:, None] * (VOT + 1) + idxs).reshape(-1)
+    flat_idx = (win_of[:, None] * (VOT + 1) + idx).reshape(-1)
     weighted = jnp.zeros(n_windows * (VOT + 1), jnp.float32)
     weighted = weighted.at[flat_idx].add(wsv.reshape(-1))
     unweighted = jnp.zeros(n_windows * (VOT + 1), jnp.int32)
@@ -195,8 +200,9 @@ def consensus_chain(qrp, tp, n, m, qcodes, qweights, begin, win_of,
     ``(winner, coverage, ins_winner, ins_emit, ins_cov, ok)``."""
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                          max_len=max_len, band=band)
-    weighted, unweighted, ok = _vote_kernel(
-        packed, score, n, m, qcodes, qweights, begin, win_of,
+    ops, fi, fj = _walk_ops_kernel(packed, n, m, max_len=max_len, band=band)
+    weighted, unweighted, ok = _vote_from_ops(
+        ops, fi, fj, score, n, m, qcodes, qweights, begin, win_of,
         n_windows=n_windows, max_len=max_len, band=band, L=L, K=K)
     out = _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
                             ins_theta, del_beta, L=L, K=K)

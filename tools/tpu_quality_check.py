@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Real-TPU quality + speed spot check for the device consensus engine.
+
+Runs the λ-phage FASTQ+PAF pipeline with the TPU consensus backend on the
+real chip and prints the rc edit distance vs NC_001416 (recorded device
+golden: 1384; CPU golden: 1324) plus warm timing. Used between perf-work
+stages to prove the device path's output is unchanged.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA = "/root/reference/test/data"
+
+
+def main():
+    from racon_tpu.core.polisher import create_polisher
+    from racon_tpu.io import parse_fasta
+    from racon_tpu import native
+
+    t0 = time.perf_counter()
+    p = create_polisher(f"{DATA}/sample_reads.fastq.gz",
+                        f"{DATA}/sample_overlaps.paf.gz",
+                        f"{DATA}/sample_layout.fasta.gz",
+                        num_threads=8, consensus_backend="tpu")
+    p.initialize()
+    (polished,) = p.polish(True)
+    wall = time.perf_counter() - t0
+    ref = list(parse_fasta(f"{DATA}/sample_reference.fasta.gz"))[0]
+    d = native.edit_distance(polished.reverse_complement, ref.data)
+    print(f"rc_distance={d} (golden 1384)  stats={p.consensus.stats}  "
+          f"wall={wall:.2f}s", flush=True)
+    return 0 if d == 1384 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
