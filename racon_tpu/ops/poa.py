@@ -6,20 +6,26 @@ device batch processes (windows x layers) at once, the analog of a cudapoa
 
 Design (TPU-first): instead of porting cudapoa's irregular
 one-block-per-group graph POA, consensus is computed as a
-**quality-weighted pileup**:
+**quality-weighted pileup** refined over several device-resident rounds:
 
 1. every layer is globally aligned to its backbone span with the wavefront
    NW kernel from ``ops.nw`` (all windows' layers in one fixed-shape batch —
    thousands of concurrent alignments, the shape TPUs like);
-2. a traceback variant walks each alignment on device and scatter-adds
-   weighted votes (A/C/G/T/N/deletion per backbone column, plus K insertion
-   slots per junction) into per-window count matrices;
+2. the walked alignment ops are turned into weighted votes (A/C/G/T/N/
+   deletion per backbone column, plus K insertion slots per junction) with
+   vectorized prefix sums and one scatter-add (``_vote_from_ops``);
 3. consensus = per-column argmax over weighted base votes, a column
    dropped when deletion weight exceeds ``del_beta`` x the summed base
    weights, and insertion slot ``s`` emitted when its summed weight
    exceeds ``ins_theta`` x the column total (see ``_consensus_kernel``),
    with per-base unweighted coverage for the reference's TGS end-trimming
-   contract (``src/window.cpp:118-139``).
+   contract (``src/window.cpp:118-139``);
+4. the emitted consensus becomes the next round's backbone **on device**:
+   ``refine_round`` rebuilds the backbone rows (prefix-sum positions + one
+   scatter) and remaps every layer span through the emitted-column map, so
+   the refinement loop runs ``rounds`` times with no host round-trip — the
+   host packs once and fetches once (the tunnel to the device costs
+   ~0.2-0.3 s per transfer, which used to dominate wall-clock).
 
 Like the reference's GPU path, this engine is allowed to differ slightly
 from the CPU spoa-semantics engine (upstream records separate CUDA goldens:
@@ -37,13 +43,16 @@ Engine caps (documented, per ADVICE round 1): insertion runs longer than
 ``K_INS`` collapse extra bases into the last slot, and insertions before
 the first backbone column of a window (junction "-1") only have a vote
 slot when the layer starts past column 0; refinement rounds recover most
-of both effects.
+of both effects. A backbone that grows past its fixed device buffer
+(``L + GROW`` columns) freezes at its last refined state — backbones are
+consensus estimates of ~window length, so growth beyond GROW columns does
+not occur on real data.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
@@ -59,16 +68,19 @@ from ..core.window import WindowType
 BAND = 512
 # Insertion slots tracked per backbone junction.
 K_INS = 4
+# Columns of backbone-growth headroom per refinement round loop.
+GROW = 256
 # Vote channels: A C G T N DEL (stride 8 for cheap addressing).
 CH = 8
 A, C, G, T, N_CODE, DEL = 0, 1, 2, 3, 4, 5
+# Packing codes distinct from every base code, so query padding never
+# "matches" target padding in the NW kernel's character compare.
+Q_PAD, T_PAD = 6, 7
 
 _CODE_LUT = np.full(256, N_CODE, dtype=np.uint8)
 for i, b in enumerate(b"ACGT"):
     _CODE_LUT[b] = i
 _BYTE_LUT = np.frombuffer(b"ACGTN-", dtype=np.uint8)
-
-MAX_PAIR_DIRS_BYTES = 1024 * 1024 * 1024
 
 
 @functools.partial(jax.jit,
@@ -190,29 +202,119 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
     return winner, coverage, ins_winner, ins_emit, ins_cov
 
 
-def consensus_chain(qrp, tp, n, m, qcodes, qweights, begin, win_of,
-                    bcodes, bweights, blen, ins_theta, del_beta, *,
-                    n_windows: int, max_len: int, band: int, L: int, K: int):
-    """Align + vote + pick-winners — the single source of truth for the
-    consensus engine's kernel wiring, wrapped unchanged by the plain path
-    (``TpuPoaConsensus._device_round``) and the ``shard_map`` path
-    (``racon_tpu.parallel.sharded_consensus_round``). Returns
-    ``(winner, coverage, ins_winner, ins_emit, ins_cov, ok)``."""
+@functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
+                                             "Lb", "K"))
+def refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
+                 bcodes, bweights, blen, covs, ever, frozen, dropped,
+                 ins_theta, del_beta, *, n_windows: int, max_len: int,
+                 band: int, Lb: int, K: int):
+    """One fully-device-resident refinement round.
+
+    Align every layer against its current backbone span, vote, pick
+    winners, then *rebuild the backbone rows on device* (emitted-entry
+    prefix sums give each emitted base its output column; one scatter
+    writes the new backbone and its coverage) and remap every layer span
+    through the emitted-column map. The host never sees intermediate
+    backbones — it packs once before round 1 and fetches once after the
+    last round. Replaces the per-round pack/fetch/Python-rebuild loop
+    (_apply_shard) whose tunnel round-trips dominated wall-clock.
+
+    Per-window state: ``bcodes/bweights/blen`` backbone rows (codes, Lb
+    columns), ``covs`` coverage of the current backbone, ``ever`` whether
+    any round succeeded (false -> CPU fallback), ``frozen`` stop-refining
+    flag (backbone outgrew Lb). ``dropped`` accumulates rejected layer
+    alignments ([1] i32). The single source of truth for the round wiring,
+    wrapped unchanged by the ``shard_map`` path
+    (``racon_tpu.parallel.sharded_refine_round``).
+    """
+    Lq = max_len
+    c = band // 2
+    width = c + Lq + band
+    m = ed - bg + 1
+
+    # ---- target rows gathered from the backbone state (codes, pad T_PAD)
+    cols = jnp.arange(width, dtype=jnp.int32)[None, :] - c
+    src = bg[:, None] + cols
+    flat_src = win_of[:, None] * Lb + jnp.clip(src, 0, Lb - 1)
+    tval = jnp.take(bcodes.reshape(-1), flat_src)
+    tp = jnp.where((cols >= 0) & (cols < m[:, None]), tval, jnp.uint8(T_PAD))
+
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
-                                         max_len=max_len, band=band)
-    ops, fi, fj = _walk_ops_kernel(packed, n, m, max_len=max_len, band=band)
-    weighted, unweighted, ok = _vote_from_ops(
-        ops, fi, fj, score, n, m, qcodes, qweights, begin, win_of,
-        n_windows=n_windows, max_len=max_len, band=band, L=L, K=K)
-    out = _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
-                            ins_theta, del_beta, L=L, K=K)
-    return out + (ok,)
+                                         max_len=Lq, band=band)
+    ops, fi, fj = _walk_ops_kernel(packed, n, m, max_len=Lq, band=band)
+    weighted, unweighted, okp = _vote_from_ops(
+        ops, fi, fj, score, n, m, qcodes, qweights, bg, win_of,
+        n_windows=n_windows, max_len=Lq, band=band, L=Lb, K=K)
+    winner, coverage, ins_winner, ins_emit, ins_cov = _consensus_kernel(
+        weighted, unweighted, bcodes, bweights, blen, ins_theta, del_beta,
+        L=Lb, K=K)
+    dropped = dropped + jnp.sum((~okp) & real)
+
+    # ---- rebuild backbone rows from emitted columns/slots.
+    # Entry order within a column: its base first, then insertion slots
+    # high-to-low (slot s holds the s-th base from the END of an insertion
+    # run — the walk is backwards — so high slots come first in sequence).
+    colr = jnp.arange(Lb, dtype=jnp.int32)[None, :]
+    in_range = colr < blen[:, None]
+    base_emit = (winner <= N_CODE) & in_range
+    ins_e = ins_emit & in_range[:, :, None]
+    ent_emit = jnp.concatenate([base_emit[:, :, None], ins_e[:, :, ::-1]], 2)
+    ent_code = jnp.concatenate(
+        [jnp.clip(winner, 0, N_CODE).astype(jnp.uint8)[:, :, None],
+         ins_winner.astype(jnp.uint8)[:, :, ::-1]], 2)
+    ent_cov = jnp.concatenate([coverage[:, :, None],
+                               ins_cov[:, :, ::-1]], 2)
+    E = Lb * (1 + K)
+    fe = ent_emit.reshape(n_windows, E).astype(jnp.int32)
+    pos = jnp.cumsum(fe, axis=1) - fe           # exclusive prefix sum
+    new_len = jnp.sum(fe, axis=1)
+    c2n = pos[:, ::(1 + K)]                     # old col -> new position
+
+    tgt = jnp.where((fe > 0) & (pos < Lb), pos, Lb)  # overflow/pad -> sink
+    rows = (jnp.arange(n_windows, dtype=jnp.int32)[:, None] * (Lb + 1)
+            + tgt).reshape(-1)
+    nb_mat = jnp.zeros(n_windows * (Lb + 1), jnp.uint8).at[rows].set(
+        ent_code.reshape(-1)).reshape(n_windows, Lb + 1)[:, :Lb]
+    nc_mat = jnp.zeros(n_windows * (Lb + 1), jnp.int32).at[rows].set(
+        ent_cov.reshape(n_windows, E).reshape(-1)).reshape(
+            n_windows, Lb + 1)[:, :Lb]
+
+    # empty consensus keeps the previous state (host analog: `continue`);
+    # overflow freezes the window at its last refined backbone
+    ok_upd = (~frozen) & (new_len > 0) & (new_len <= Lb)
+    frozen = frozen | (new_len > Lb)
+    bcodes = jnp.where(ok_upd[:, None], nb_mat, bcodes)
+    covs = jnp.where(ok_upd[:, None], nc_mat, covs)
+    bweights = jnp.where(ok_upd[:, None], 0.0, bweights)  # refined backbone
+                                                          # carries no phred
+    ever = ever | ok_upd
+
+    # ---- remap layer spans through the emitted-column map
+    blen_g = jnp.take(blen, win_of)
+    nl_g = jnp.take(new_len, win_of)
+
+    def lookup(col):
+        cl = jnp.minimum(col, blen_g)
+        v = jnp.take(c2n.reshape(-1),
+                     win_of * Lb + jnp.clip(cl, 0, Lb - 1))
+        return jnp.where(cl >= blen_g, nl_g, v)  # col_to_new[blen] = len
+
+    nb = lookup(bg)
+    ne = jnp.maximum(nb + 1, lookup(ed + 1) - 1)
+    nb = jnp.minimum(nb, nl_g - 1)
+    ne = jnp.minimum(ne, nl_g - 1)
+    upd_p = jnp.take(ok_upd, win_of)
+    bg = jnp.where(upd_p, nb, bg)
+    ed = jnp.where(upd_p, ne, ed)
+    blen = jnp.where(ok_upd, new_len, blen)
+
+    return bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped
 
 
 class _Work:
-    """Mutable per-window state across refinement rounds."""
+    """Per-window packing view (layers capped at ``max_depth``)."""
 
-    __slots__ = ("win", "backbone", "bqual", "layers", "n_seqs", "covs")
+    __slots__ = ("win", "backbone", "bqual", "layers", "n_seqs")
 
     def __init__(self, win, max_depth, stats):
         self.win = win
@@ -225,7 +327,6 @@ class _Work:
             b, e = win.positions[li]
             self.layers.append((win.sequences[li], win.qualities[li], b, e))
         self.n_seqs = len(win.sequences)
-        self.covs = None
 
 
 class TpuPoaConsensus:
@@ -234,7 +335,13 @@ class TpuPoaConsensus:
     ``rounds`` controls iterative refinement: round r re-aligns every layer
     against the round r-1 consensus (with layer spans remapped through the
     emitted-column map), which recovers most of the gap between one-shot
-    pileup voting and graph POA.
+    pileup voting and graph POA. All rounds run device-resident
+    (:func:`refine_round`); the host packs once and fetches once.
+
+    ``mesh``: optional 1-D :class:`jax.sharding.Mesh`; window groups are
+    LPT-split across shards and the whole refinement loop runs under
+    ``shard_map`` (multi-chip analog of cudapoa's per-GPU batch binning,
+    ``src/cuda/cudapolisher.cpp:72-83``).
     """
 
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
@@ -251,9 +358,9 @@ class TpuPoaConsensus:
         self.ins_theta = ins_theta
         self.del_beta = del_beta
         # Batch count (reference -c N, cudapolisher.cpp:215-228): windows
-        # are LPT-split into N groups per refinement round, all dispatched
-        # before the first result is fetched, so host packing overlaps
-        # device compute.
+        # are LPT-split into N groups, every group's whole refinement loop
+        # is dispatched before the first result is fetched (JAX async
+        # dispatch), so host packing overlaps device compute.
         self.num_batches = max(1, num_batches)
         self.stats = {"device_windows": 0, "fallback_windows": 0,
                       "dropped_layers": 0, "passthrough": 0}
@@ -276,47 +383,40 @@ class TpuPoaConsensus:
             if len(w.layers) < 2:
                 results[i] = None  # CPU fallback
 
-        for rnd in range(self.rounds):
-            if not live:
-                break
+        if live:
             max_bb = max(len(w.backbone) for _, w in live)
             L = max(256, -(-max_bb // 256) * 256)
             Lq = L + self.band
-            fit, rejected = [], []
-            for i, w in live:
-                if all(len(s) <= Lq for s, _, _, _ in w.layers):
-                    fit.append((i, w))
-                else:
-                    rejected.append(i)
-            live = fit
-            if not live:
-                break
-            self._device_round(live, L, Lq)
-            if progress is not None:
-                # bar units = refinement rounds (+1 for stitch/fallback)
-                progress(rnd + 1, self.rounds + 1)
+            Lb = min(L + GROW, Lq)  # backbone buffer (span fit: Lb <= Lq)
+            # windows whose layers exceed the pair buffer (or backbones the
+            # backbone buffer) go to the CPU fallback via results[i] None
+            live = [(i, w) for i, w in live
+                    if all(len(s) <= Lq for s, _, _, _ in w.layers)
+                    and len(w.backbone) <= Lb]
 
-        for i, w in live:
-            covs = w.covs
-            consensus = w.backbone
-            if covs is None:  # no successful device round
-                results[i] = None
-                continue
-            if w.win.type == WindowType.TGS and trim:
-                # threshold uses the *voted* depth: layers beyond max_depth
-                # never vote, so counting them would make trimming a no-op
-                # on windows deeper than ~2x max_depth
-                avg_cov = min(w.n_seqs - 1, self.max_depth) // 2
-                b_, e_ = 0, len(consensus) - 1
-                while b_ < len(consensus) and covs[b_] < avg_cov:
-                    b_ += 1
-                while e_ >= 0 and covs[e_] < avg_cov:
-                    e_ -= 1
-                if b_ < e_:
-                    consensus = consensus[b_:e_ + 1]
-            w.win.consensus = consensus
-            results[i] = True
-            self.stats["device_windows"] += 1
+        if live:
+            from ..parallel import partition_balanced
+            if self.num_batches == 1:
+                groups = [list(live)]
+            else:
+                bins = partition_balanced([len(w.layers) for _, w in live],
+                                          self.num_batches)
+                groups = [[live[i] for i in b] for b in bins if b]
+            launches = [self._launch_group(g, Lq, Lb) for g in groups]
+            for rnd in range(self.rounds):
+                for la in launches:
+                    self._round(la, Lq, Lb)
+                if progress is not None:
+                    # bar units = dispatched refinement rounds (+1 for the
+                    # fetch/stitch/fallback tail): rounds are dispatched
+                    # asynchronously and only the final fetch blocks, so
+                    # ticks show work entering the device pipeline, not
+                    # round completion — syncing per round to tick on
+                    # completion would reintroduce the tunnel round-trips
+                    # this engine exists to avoid
+                    progress(rnd + 1, self.rounds + 1)
+            for la in launches:
+                self._finish_group(la, trim, results)
 
         cpu_idx = [i for i, r in enumerate(results) if r is None]
         if cpu_idx:
@@ -333,7 +433,7 @@ class TpuPoaConsensus:
 
     # -------------------------------------------------------------- device
 
-    def _pack_shard(self, items, L, Lq, B, nWp):
+    def _pack_shard(self, items, Lq, B, nWp, Lb):
         """Pack one shard's windows into fixed-shape pair/window arrays.
 
         ``items`` is a list of ``(result_index, _Work)``; pair rows beyond
@@ -343,38 +443,36 @@ class TpuPoaConsensus:
         c = band // 2
         width = c + Lq + band
 
-        qrp = np.zeros((B, width), np.uint8)
-        tp = np.zeros((B, width), np.uint8)
+        qrp = np.full((B, width), Q_PAD, np.uint8)
         n = np.ones(B, np.int32)
-        m = np.ones(B, np.int32)
         qcodes = np.zeros((B, Lq), np.uint8)
         qweights = np.zeros((B, Lq), np.float32)
-        begin = np.zeros(B, np.int32)
+        bg = np.zeros(B, np.int32)
+        ed = np.zeros(B, np.int32)
         win_of = np.full(B, nWp - 1, np.int32)  # padding -> sink window
+        real = np.zeros(B, bool)
 
         k = 0
         for wi, (_, w) in enumerate(items):
-            for seq, qual, bg, ed in w.layers:
-                bb = w.backbone
-                bg = min(bg, len(bb) - 1)
-                ed = min(ed, len(bb) - 1)
-                span = bb[bg:ed + 1]
-                qrp[k, c + Lq - len(seq): c + Lq] = \
-                    np.frombuffer(seq, np.uint8)[::-1]
-                tp[k, c: c + len(span)] = np.frombuffer(span, np.uint8)
-                n[k], m[k] = len(seq), len(span)
-                qcodes[k, :len(seq)] = _CODE_LUT[np.frombuffer(seq, np.uint8)]
+            blen_w = len(w.backbone)
+            for seq, qual, b, e in w.layers:
+                codes = _CODE_LUT[np.frombuffer(seq, np.uint8)]
+                qrp[k, c + Lq - len(seq): c + Lq] = codes[::-1]
+                n[k] = len(seq)
+                qcodes[k, :len(seq)] = codes
                 if qual is not None:
                     qweights[k, :len(seq)] = \
                         np.frombuffer(qual, np.uint8).astype(np.float32) - 33.0
                 else:
                     qweights[k, :len(seq)] = 1.0
-                begin[k] = bg
+                bg[k] = min(b, blen_w - 1)
+                ed[k] = min(e, blen_w - 1)
                 win_of[k] = wi
+                real[k] = True
                 k += 1
 
-        bcodes = np.zeros((nWp, L), np.uint8)
-        bweights = np.zeros((nWp, L), np.float32)
+        bcodes = np.zeros((nWp, Lb), np.uint8)
+        bweights = np.zeros((nWp, Lb), np.float32)
         blen = np.zeros(nWp, np.int32)
         for wi, (_, w) in enumerate(items):
             bb = w.backbone
@@ -384,33 +482,14 @@ class TpuPoaConsensus:
                     np.frombuffer(w.bqual, np.uint8).astype(np.float32) - 33.0
             blen[wi] = len(bb)
 
-        return (qrp, tp, n, m, qcodes, qweights, begin, win_of), \
-               (bcodes, bweights, blen), k
+        return (qrp, n, qcodes, qweights, win_of, real, bg, ed), \
+               (bcodes, bweights, blen)
 
-    def _device_round(self, live, L, Lq) -> None:
-        """One align+vote+consensus pass; updates each _Work in place.
-
-        Windows are LPT-split into ``num_batches`` groups, every group's
-        kernels are dispatched before the first group's results are
-        fetched (JAX async dispatch), and results apply in order."""
-        from ..parallel import partition_balanced
-        if self.num_batches == 1:
-            groups = [list(live)]
-        else:
-            bins = partition_balanced([len(w.layers) for _, w in live],
-                                      self.num_batches)
-            groups = [[live[i] for i in b] for b in bins if b]
-        launches = [self._launch_group(g, L, Lq) for g in groups]
-        for launch in launches:
-            self._finish_group(launch)
-
-    def _launch_group(self, live, L, Lq):
+    def _launch_group(self, live, Lq, Lb):
         """Pack one window group (per-mesh-shard when a mesh is set — pairs
-        of a window never cross shards, so votes stay shard-local) and
-        dispatch its align+vote+consensus kernels without blocking."""
-        from ..parallel import (mesh_size, partition_balanced,
-                                sharded_consensus_round)
-        band = self.band
+        of a window never cross shards, so votes stay shard-local) into the
+        device-resident refinement state."""
+        from ..parallel import mesh_size, partition_balanced
         nd = mesh_size(self.mesh)
         if nd == 1:
             shards = [list(live)]
@@ -427,80 +506,65 @@ class TpuPoaConsensus:
         while nWp < max_wins + 1:
             nWp *= 2
 
-        packs = [self._pack_shard(sh, L, Lq, B, nWp) for sh in shards]
+        packs = [self._pack_shard(sh, Lq, B, nWp, Lb) for sh in shards]
+        pair_np = [np.concatenate([p[0][a] for p in packs])
+                   for a in range(8)]
+        win_np = [np.concatenate([p[1][a] for p in packs])
+                  for a in range(3)]
+        static = tuple(jnp.asarray(a) for a in pair_np[:6])   # qrp..real
+        bg, ed = (jnp.asarray(pair_np[6]), jnp.asarray(pair_np[7]))
+        bcodes, bweights, blen = (jnp.asarray(a) for a in win_np)
+        covs = jnp.zeros((nd * nWp, Lb), jnp.int32)
+        ever = jnp.zeros(nd * nWp, bool)
+        frozen = jnp.zeros(nd * nWp, bool)
+        dropped = jnp.zeros(nd, jnp.int32)
+        state = [bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped]
+        return {"shards": shards, "static": static, "state": state,
+                "nWp": nWp, "nd": nd}
 
-        if nd == 1:
-            pair_arrays, window_arrays, nP = packs[0]
-            out = consensus_chain(
-                *(jnp.asarray(a) for a in pair_arrays),
-                *(jnp.asarray(a) for a in window_arrays),
-                jnp.float32(self.ins_theta), jnp.float32(self.del_beta),
-                n_windows=nWp, max_len=Lq, band=band, L=L, K=K_INS)
+    def _round(self, launch, Lq, Lb) -> None:
+        """Dispatch one refinement round for a group (no host sync)."""
+        static, state = launch["static"], launch["state"]
+        theta = jnp.float32(self.ins_theta)
+        beta = jnp.float32(self.del_beta)
+        if launch["nd"] == 1:
+            out = refine_round(
+                *static, *state, theta, beta,
+                n_windows=launch["nWp"], max_len=Lq, band=self.band,
+                Lb=Lb, K=K_INS)
         else:
-            pair_stk = [np.concatenate([p[0][a] for p in packs])
-                        for a in range(8)]
-            win_stk = [np.concatenate([p[1][a] for p in packs])
-                       for a in range(3)]
-            out = sharded_consensus_round(
-                self.mesh,
-                tuple(jnp.asarray(a) for a in pair_stk),
-                tuple(jnp.asarray(a) for a in win_stk),
-                n_windows_local=nWp, max_len=Lq, band=band, L=L, K=K_INS,
-                ins_theta=self.ins_theta, del_beta=self.del_beta)
-        n_pairs = [p[2] for p in packs]
-        return shards, out, n_pairs, B, nWp, nd
+            from ..parallel import sharded_refine_round
+            out = sharded_refine_round(
+                self.mesh, static, state, theta, beta,
+                n_windows_local=launch["nWp"], max_len=Lq, band=self.band,
+                Lb=Lb, K=K_INS)
+        launch["state"] = list(out)
 
-    def _finish_group(self, launch) -> None:
-        """Fetch one launched group's results and apply them in place."""
-        shards, out, n_pairs, B, nWp, nd = launch
-        res = [np.asarray(x) for x in jax.device_get(out)]
-        # fixed output order: five window-major arrays, then pair-major ok
-        strides = (nWp, nWp, nWp, nWp, nWp, B)
-        shard_results = []
-        for s in range(nd):
-            shard_results.append(tuple(
-                r[s * st:(s + 1) * st] for r, st in zip(res, strides)))
-
-        for sh, (winner, coverage, ins_winner, ins_emit, ins_cov, ok), nP \
-                in zip(shards, shard_results, n_pairs):
-            self.stats["dropped_layers"] += int((~ok[:nP]).sum())
-            self._apply_shard(sh, winner, coverage, ins_winner, ins_emit,
-                              ins_cov)
-
-    def _apply_shard(self, items, winner, coverage, ins_winner, ins_emit,
-                     ins_cov) -> None:
-        for wi, (_, w) in enumerate(items):
-            blen_i = len(w.backbone)
-            out_bytes = bytearray()
-            covs: List[int] = []
-            # emitted-column map for layer-span remapping in later rounds
-            col_to_new = np.zeros(blen_i + 1, np.int32)
-            for col in range(blen_i):
-                col_to_new[col] = len(out_bytes)
-                ch = int(winner[wi, col])
-                if ch <= N_CODE:
-                    out_bytes.append(_BYTE_LUT[ch])
-                    covs.append(int(coverage[wi, col]))
-                # slot s holds the s-th base from the END of an insertion
-                # run (the walk is backwards), so emit high slots first
-                for s_ in range(K_INS - 1, -1, -1):
-                    if ins_emit[wi, col, s_]:
-                        out_bytes.append(
-                            _BYTE_LUT[int(ins_winner[wi, col, s_])])
-                        covs.append(int(ins_cov[wi, col, s_]))
-            col_to_new[blen_i] = len(out_bytes)
-
-            new_bb = bytes(out_bytes)
-            if len(new_bb) == 0:
-                continue  # degenerate; keep previous backbone/covs
-            new_layers = []
-            for seq, qual, bg, ed in w.layers:
-                nb = int(col_to_new[min(bg, blen_i)])
-                ne = max(nb + 1, int(col_to_new[min(ed + 1, blen_i)]) - 1)
-                nb = min(nb, len(new_bb) - 1)
-                ne = min(ne, len(new_bb) - 1)
-                new_layers.append((seq, qual, nb, ne))
-            w.backbone = new_bb
-            w.bqual = None  # refined consensus carries no phred quality
-            w.layers = new_layers
-            w.covs = covs
+    def _finish_group(self, launch, trim: bool, results) -> None:
+        """One host fetch per group; decode consensus bytes + trim."""
+        shards, nWp = launch["shards"], launch["nWp"]
+        # fetch only what the stitch needs (bg/ed/bweights/frozen stay on
+        # device — every transferred byte rides the slow tunnel)
+        _, _, bcodes, _, blen, covs, ever, _, dropped = launch["state"]
+        bcodes, blen, covs, ever, dropped = jax.device_get(
+            [bcodes, blen, covs, ever, dropped])
+        self.stats["dropped_layers"] += int(dropped.sum())
+        for s, sh in enumerate(shards):
+            for wi, (i, w) in enumerate(sh):
+                row = s * nWp + wi
+                if not ever[row]:
+                    results[i] = None  # no successful round -> CPU fallback
+                    continue
+                bl = int(blen[row])
+                consensus = _BYTE_LUT[bcodes[row, :bl]].tobytes()
+                if w.win.type == WindowType.TGS and trim:
+                    # threshold uses the *voted* depth: layers beyond
+                    # max_depth never vote, so counting them would make
+                    # trimming a no-op on windows deeper than ~2x max_depth
+                    avg_cov = min(w.n_seqs - 1, self.max_depth) // 2
+                    good = np.flatnonzero(covs[row, :bl] >= avg_cov)
+                    if len(good) and good[0] < good[-1]:
+                        consensus = consensus[good[0]:good[-1] + 1]
+                w.win.consensus = consensus
+                results[i] = True
+                self.stats["device_windows"] += 1

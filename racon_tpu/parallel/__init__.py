@@ -16,8 +16,8 @@ Public surface:
 - :func:`get_mesh` — build a 1-D mesh over (a prefix of) the local devices;
 - :func:`sharded_align` — batched wavefront-NW + on-device traceback,
   batch dim sharded (used by :class:`racon_tpu.ops.nw.TpuAligner`);
-- :func:`sharded_consensus_round` — one align+vote+consensus pass with
-  pair arrays and window arrays co-sharded (used by
+- :func:`sharded_refine_round` — one device-resident consensus refinement
+  round with pair arrays and window state co-sharded (used by
   :class:`racon_tpu.ops.poa.TpuPoaConsensus`);
 - :func:`partition_balanced` — greedy LPT binning of variable-cost items
   into per-shard groups (host-side analog of the reference's dynamic work
@@ -94,41 +94,40 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_consensus_fn(mesh: Mesh, n_windows_local: int, max_len: int,
-                          band: int, L: int, K: int, ins_theta: float,
-                          del_beta: float):
-    from ..ops.poa import consensus_chain
-    import jax.numpy as jnp
+def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
+                       band: int, Lb: int, K: int):
+    from ..ops.poa import refine_round
 
-    def local(qrp, tp, n, m, qcodes, qweights, begin, win_of,
-              bcodes, bweights, blen):
-        return consensus_chain(qrp, tp, n, m, qcodes, qweights, begin,
-                               win_of, bcodes, bweights, blen,
-                               jnp.float32(ins_theta), jnp.float32(del_beta),
-                               n_windows=n_windows_local, max_len=max_len,
-                               band=band, L=L, K=K)
+    def local(qrp, n, qcodes, qweights, win_of, real, bg, ed,
+              bcodes, bweights, blen, covs, ever, frozen, dropped,
+              ins_theta, del_beta):
+        return refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
+                            bcodes, bweights, blen, covs, ever, frozen,
+                            dropped, ins_theta, del_beta,
+                            n_windows=n_windows_local, max_len=max_len,
+                            band=band, Lb=Lb, K=K)
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(
-        local, mesh=mesh, in_specs=(spec,) * 11, out_specs=(spec,) * 6,
-        check_vma=False))
+        local, mesh=mesh, in_specs=(spec,) * 15 + (P(), P()),
+        out_specs=(spec,) * 9, check_vma=False))
 
 
-def sharded_consensus_round(mesh: Mesh, pair_arrays, window_arrays, *,
-                            n_windows_local: int, max_len: int, band: int,
-                            L: int, K: int, ins_theta: float,
-                            del_beta: float):
-    """One consensus pass (align + vote + winners) over a co-sharded batch.
+def sharded_refine_round(mesh: Mesh, static, state, ins_theta, del_beta, *,
+                         n_windows_local: int, max_len: int, band: int,
+                         Lb: int, K: int):
+    """One device-resident refinement round over a co-sharded batch.
 
-    ``pair_arrays`` = (qrp, tp, n, m, qcodes, qweights, begin, win_of) with
-    leading dim ``n_shards * B_local``; ``win_of`` holds **shard-local**
-    window ordinals.  ``window_arrays`` = (bcodes, bweights, blen) with
-    leading dim ``n_shards * n_windows_local``.  Pairs belonging to one
-    window must live in that window's shard — :func:`partition_balanced`
-    plus per-shard packing guarantees it, so no cross-shard reduction is
-    needed.  Returns ``(winner, coverage, ins_winner, ins_emit, ins_cov,
-    ok)`` stacked the same way.
+    ``static`` = (qrp, n, qcodes, qweights, win_of, real) with leading dim
+    ``n_shards * B_local``; ``win_of`` holds **shard-local** window
+    ordinals.  ``state`` = (bg, ed, bcodes, bweights, blen, covs, ever,
+    frozen, dropped) — pair-major arrays share the pair stacking, window
+    rows have leading dim ``n_shards * n_windows_local``, ``dropped`` is
+    one counter per shard.  Pairs belonging to one window must live in
+    that window's shard — :func:`partition_balanced` plus per-shard
+    packing guarantees it, so no cross-shard reduction is needed and the
+    whole refinement loop scales collective-free.  Returns the updated
+    ``state`` stacked the same way.
     """
-    fn = _sharded_consensus_fn(mesh, n_windows_local, max_len, band, L, K,
-                               ins_theta, del_beta)
-    return fn(*pair_arrays, *window_arrays)
+    fn = _sharded_refine_fn(mesh, n_windows_local, max_len, band, Lb, K)
+    return fn(*static, *state, ins_theta, del_beta)
