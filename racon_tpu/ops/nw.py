@@ -50,8 +50,9 @@ TYPICAL_DIVERGENCE = 0.25
 # (v5e has 16 GiB HBM; the matrix never leaves the device).
 MAX_DIRS_BYTES = 1536 * 1024 * 1024
 
-@functools.partial(jax.jit, static_argnames=("max_len", "band"))
-def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int):
+@functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
+def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
+                         steps: int = 0):
     """Banded anti-diagonal wavefront DP for one bucket batch.
 
     Coordinate frame: wavefront ``a = i + j`` (scan axis), diagonal
@@ -69,15 +70,21 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int):
       tp:  uint8 [B, band/2 + max_len + band] — target at offset ``band/2``;
       n, m: int32 [B] true lengths.
 
-    Returns (dirs_packed uint8 [B, 2*max_len, band/8], score int32 [B]):
+    Returns (dirs_packed uint8 [B, steps, band/8], score int32 [B]):
     per-wavefront 2-bit direction codes (0=M diag, 1=I consume-query,
-    2=D consume-target), 4 lanes per byte, traced back on the host by
-    ``rt_banded_traceback``.
+    2=D consume-target), 4 lanes per byte (planar).
+
+    ``steps`` bounds the anti-diagonal sweep (default ``2*max_len``):
+    callers that know the longest real pair pass ``ceil(max(n+m))``
+    rounded to 256, cutting the dead wavefronts past the last finish
+    (pairs with ``n + m > steps`` never reach their final cell, keep
+    score BIG, and are rejected like band escapes).
     """
     W = band
     c = W // 2
     L = max_len
     U = W // 2  # lanes per wavefront
+    S = steps if steps else 2 * L
     BIG = jnp.int32(1 << 28)
 
     us = jnp.arange(U, dtype=jnp.int32)
@@ -123,9 +130,12 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int):
             fin = jnp.take(v, jnp.clip(u_fin, 0, U - 1))
             score = jnp.where(a == nn + mm, fin, score)
 
-            d4 = d.reshape(U // 4, 4)
-            packed = (d4[:, 0] | (d4[:, 1] << 2) | (d4[:, 2] << 4)
-                      | (d4[:, 3] << 6))
+            # planar 2-bit pack: byte k holds lanes k, k+RB, k+2RB, k+3RB
+            # (static contiguous slices — no cross-lane reshuffle, so the
+            # same format is cheap in both this kernel and the Pallas one)
+            RB = U // 4
+            packed = (d[:RB] | (d[RB:2 * RB] << 2) | (d[2 * RB:3 * RB] << 4)
+                      | (d[3 * RB:] << 6))
             return (v, v1, score), packed
 
         # wavefront 0: only (0,0) at u0 = (c - p0)/2
@@ -136,7 +146,7 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int):
         score0 = jnp.where(nn + mm == 0, 0, BIG)
         (v, v1, score), packed = lax.scan(
             step, (v0, vm1, score0),
-            jnp.arange(1, 2 * L + 1, dtype=jnp.int32))
+            jnp.arange(1, S + 1, dtype=jnp.int32))
         return packed, score
 
     return jax.vmap(per_pair)(qrp, tp, n, m)
@@ -145,13 +155,17 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int):
 def _walk_op(pk, i, j, *, c, RB, S, U):
     """Shared one-step decode of the packed direction matrix during a
     backward walk from (i, j). Returns (op, di, dj): op 0=M, 1=I, 2=D,
-    3=done-or-stalled (band escape stalls so final (i,j) != 0 flags it)."""
+    3=done-or-stalled (band escape stalls so final (i,j) != 0 flags it).
+    Planar layout: lane u lives in byte ``u % RB`` at shift ``2*(u//RB)``."""
     a = i + j
     p = (a + c) & 1
     u = (j - i + c - p) // 2
-    pos = (a - 1) * RB + u // 4
+    pos = (a - 1) * RB + u % RB
     byte = jnp.take(pk, jnp.clip(pos, 0, S * RB - 1))
-    d = ((byte >> (2 * (u % 4).astype(jnp.uint8))) & 3).astype(jnp.uint8)
+    # clip the plane index: escaped u (< 0 or >= U) decodes garbage, but
+    # the `escaped` flag below overrides the op — just keep the shift legal
+    plane = jnp.clip(u // RB, 0, 3).astype(jnp.uint8)
+    d = ((byte >> (2 * plane)) & 3).astype(jnp.uint8)
     d = jnp.where(i == 0, jnp.uint8(2), d)              # only D left
     d = jnp.where((j == 0) & (i > 0), jnp.uint8(1), d)  # only I left
     escaped = (i > 0) & (j > 0) & ((u < 0) | (u >= U))
@@ -162,30 +176,32 @@ def _walk_op(pk, i, j, *, c, RB, S, U):
     return op, di, dj
 
 
-@functools.partial(jax.jit, static_argnames=("max_len", "band"))
-def _walk_ops_kernel(packed, n, m, *, max_len: int, band: int):
+@functools.partial(jax.jit, static_argnames=("band",))
+def _walk_ops_kernel(packed, n, m, *, band: int):
     """On-device traceback: vmapped pointer chase over the packed direction
     matrix (which never leaves HBM — downloading it dominated wall-clock
     otherwise). Emits one op code per step, consumed backwards from (n, m):
     0=M, 1=I, 2=D, 3=done-or-band-escape. Exactly n+m real steps per pair
     (a band escape stalls the walk, leaving the final ``(fi, fj) != 0``).
-    Returns unpacked ``(ops [B, 2L] u8, fi, fj)`` — stays on device for the
-    consensus vote path; the aligner packs via :func:`_traceback_kernel`.
+    Walk length follows ``packed``'s wavefront-row count (the producer's
+    ``steps`` bound, default ``2*max_len``). Returns unpacked
+    ``(ops [B, steps] u8, fi, fj)`` — stays on device for the consensus
+    vote path; the aligner packs via :func:`_traceback_kernel`.
     """
-    L, W = max_len, band
+    W = band
     c = W // 2
     U = W // 2
     RB = W // 8
-    B = packed.shape[0]
-    flat = packed.reshape(B, 2 * L * RB)
+    B, S = packed.shape[0], packed.shape[1]
+    flat = packed.reshape(B, S * RB)
 
     def per_pair(pk, nn, mm):
         def step(carry, _):
             i, j = carry
-            op, di, dj = _walk_op(pk, i, j, c=c, RB=RB, S=2 * L, U=U)
+            op, di, dj = _walk_op(pk, i, j, c=c, RB=RB, S=S, U=U)
             return (i - di, j - dj), op
 
-        (fi, fj), ops = lax.scan(step, (nn, mm), None, length=2 * L)
+        (fi, fj), ops = lax.scan(step, (nn, mm), None, length=S)
         return ops, fi, fj
 
     return jax.vmap(per_pair)(flat, n, m)
@@ -196,22 +212,22 @@ def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
     """Aligner-facing traceback: walks on device, then packs the op codes
     2-bit x 4-per-byte so one host round-trip fetches everything (the
     tunnel to the device has ~0.2s per-transfer latency)."""
-    L = max_len
-    B = packed.shape[0]
-    ops, fi, fj = _walk_ops_kernel(packed, n, m, max_len=max_len, band=band)
-    o4 = ops.reshape(B, (2 * L) // 4, 4)
+    B, S = packed.shape[0], packed.shape[1]
+    ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band)
+    o4 = ops.reshape(B, S // 4, 4)
     ops_packed = (o4[:, :, 0] | (o4[:, :, 1] << 2) | (o4[:, :, 2] << 4)
                   | (o4[:, :, 3] << 6))
     return ops_packed, score, fi, fj
 
 
-def align_chain(qrp, tp, n, m, *, max_len: int, band: int):
+def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0):
     """Wavefront NW + on-device traceback — the single source of truth for
     the aligner's kernel wiring, wrapped unchanged by both the plain path
     (``TpuAligner._run_chunk``) and the ``shard_map`` path
     (``racon_tpu.parallel.sharded_align``)."""
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
-                                         max_len=max_len, band=band)
+                                         max_len=max_len, band=band,
+                                         steps=steps)
     return _traceback_kernel(packed, score, n, m, max_len=max_len, band=band)
 
 

@@ -1,0 +1,381 @@
+"""Pallas TPU kernels for the banded wavefront NW forward pass + walk.
+
+Why Pallas here (SURVEY §7's "centerpiece" kernel): the XLA ``lax.scan``
+formulations round-trip their carries through HBM every wavefront step —
+at ``band/2`` lanes per pair that is ~8 MB of carry traffic per step for a
+2048-pair batch, making the kernel HBM-bound at ~45 µs/step. These kernels
+keep the two live wavefronts **in VMEM/registers for the whole sweep** and
+stream only the 2-bit direction planes to HBM (the data actually needed
+later), which is the TPU analog of cudaaligner's shared-memory DP tiles
+(``src/cuda/cudaaligner.cpp:39-44`` batch contract; one fused kernel per
+batch like ``src/cuda/cudabatch.cpp:188-199``).
+
+Layout contract (shared bit-for-bit with the XLA kernels in ``ops.nw`` so
+either backend's output feeds either consumer):
+
+- direction matrix: per wavefront ``a`` a row of ``RB = band/8`` bytes,
+  planar 2-bit packing — lane ``u`` lives in byte ``u % RB`` at bit shift
+  ``2 * (u // RB)`` (static contiguous slices in both producers);
+- walk op codes: uint8, 0=M, 1=I, 2=D, >=3 inactive. The Pallas walk is
+  *wavefront-synchronized*: one step per global anti-diagonal ``a`` from
+  ``S`` down to 1, each pair acting only when its position sits on ``a``
+  (an M step skips one diagonal, leaving an inactive-gap code 3). Codes
+  stay in backward-walk order, so consumers that mask on ``op < 3``
+  (``_vote_from_ops``, CIGAR RLE after filtering) accept both backends'
+  outputs unchanged.
+
+Mosaic's vector unit only addresses 128-lane-aligned windows, so every
+dynamic access goes through one of two shapes:
+
+- *aligned-load + dynamic roll* for the per-step character windows (load
+  ``U + 128`` lanes at the enclosing 128-multiple, then ``pltpu.roll`` by
+  the traced remainder — dynamic shifts are supported);
+- *rolling 128-lane buffers* for sub-128 stores (direction rows and walk
+  ops accumulate in a register buffer shifted ``RB``/1 lanes per step and
+  flush to the output ref every 128 lanes at a ``pl.multiple_of`` offset).
+
+The walk streams direction rows through a double-buffered VMEM window in
+*descending-a* chunks (the only order the walk needs), so the matrix never
+materializes in VMEM and arbitrarily long buckets fit.
+
+Availability is probed once (``pallas_ok()``) by running both kernels on
+a random small batch and comparing bit-for-bit against the XLA reference
+kernels; on hosts whose backend cannot lower Mosaic (the CPU test mesh)
+or where the comparison fails, callers fall back to the XLA kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+_BIG = 1 << 28
+# extra tail lanes so aligned-window loads never run off the char arrays
+_LOAD_PAD = 256
+
+
+def _rup(x: int, k: int) -> int:
+    return -(-x // k) * k
+
+
+def _load_window(ref, off, width: int, U: int):
+    """Load ``U`` lanes at traced offset ``off`` (clamped like XLA's
+    ``dynamic_slice_in_dim``) via an aligned wide load + dynamic roll
+    (Mosaic's vector unit only addresses 128-lane-aligned windows, and
+    ``tpu.dynamic_rotate`` wants int32 at 128-multiple widths)."""
+    offc = jnp.clip(off, 0, width - U)
+    base = pl.multiple_of((offc // 128) * 128, 128)
+    W2 = _rup(U, 128) + 128
+    win = ref[:, pl.ds(base, W2)].astype(jnp.int32)
+    r = offc - base
+    return pltpu.roll(win, shift=(W2 - r) % W2, axis=1)[:, :U]
+
+
+def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref, *,
+                max_len: int, band: int, P: int, width: int, steps: int):
+    W = band
+    c = W // 2
+    L = max_len
+    U = W // 2
+    RB = U // 4
+    S = steps
+    # flush F wavefront rows per store so offsets stay 128-lane aligned
+    # (F*RB = lcm(RB, 128); e.g. RB=48 -> 8 rows / 384 lanes per flush)
+    FL = RB
+    while FL % 128:
+        FL += RB
+    F = FL // RB
+    nn = n_ref[:, :]  # (P, 1) i32
+    mm = m_ref[:, :]
+    us = lax.broadcasted_iota(jnp.int32, (P, U), 1)
+
+    p0 = c & 1
+    u0 = (c - p0) // 2
+    # `zrow` is zero for every real length but opaque to constant folding:
+    # adding it forces a row-varying (non-sublane-replicated) Mosaic layout
+    # on the loop carries — the body's outputs are row-varying and Mosaic
+    # cannot relayout varying data into a replicated carry
+    zrow = jnp.minimum(nn, 0)
+    v0 = jnp.where(us == u0, 0, _BIG) + zrow
+    vm1 = jnp.full((P, U), _BIG, jnp.int32) + zrow
+    score0 = jnp.where(nn + mm == 0, 0, _BIG)
+    dbuf0 = jnp.zeros((P, FL), jnp.int32) + zrow
+
+    def step(a, carry):
+        v1, v2, score, dbuf = carry
+        p = (a + c) & 1
+        I0 = (a + c - p) // 2
+        J0 = (a - c + p) // 2
+        i_vec = I0 - us
+        j_vec = J0 + us
+
+        # shifted views of wavefront a-1 (parity alternates):
+        #   p == 0: D-source = v1[u-1], I-source = v1[u]
+        #   p == 1: D-source = v1[u],   I-source = v1[u+1]
+        v1_left = jnp.where(us == 0, _BIG, pltpu.roll(v1, shift=1, axis=1))
+        v1_right = jnp.where(us == U - 1, _BIG,
+                             pltpu.roll(v1, shift=U - 1, axis=1))
+        d_src = jnp.where(p == 0, v1_left, v1)
+        i_src = jnp.where(p == 0, v1, v1_right)
+
+        qchars = _load_window(qrp_ref, c + L - I0, width, U)
+        tchars = _load_window(tp_ref, c + J0 - 1, width, U)
+        sub = jnp.where(qchars == tchars, 0, 1)
+
+        cd = v2 + sub          # diagonal (i-1, j-1)
+        ci = i_src + 1         # consume query (i-1, j)
+        cdel = d_src + 1       # consume target (i, j-1)
+        best = jnp.minimum(cd, jnp.minimum(ci, cdel))
+        d = jnp.where(cd == best, 0, jnp.where(ci == best, 1, 2))
+
+        interior = (i_vec >= 1) & (i_vec <= nn) & (j_vec >= 1) & (j_vec <= mm)
+        v = jnp.where(interior, jnp.minimum(best, _BIG), _BIG)
+        v = jnp.where((i_vec == 0) & (j_vec >= 0) & (j_vec <= mm), j_vec, v)
+        v = jnp.where((j_vec == 0) & (i_vec >= 1) & (i_vec <= nn), i_vec, v)
+
+        # final score lives at a == n + m, u_fin = (m - n + c - p) / 2
+        u_fin = jnp.clip((mm - nn + c - p) // 2, 0, U - 1)
+        fin = jnp.sum(jnp.where(us == u_fin, v, 0), axis=1, keepdims=True)
+        score = jnp.where(a == nn + mm, fin, score)
+
+        packed = (d[:, :RB] | (d[:, RB:2 * RB] << 2)
+                  | (d[:, 2 * RB:3 * RB] << 4) | (d[:, 3 * RB:] << 6))
+        # rolling flush buffer: row a lands in the last RB lanes; every F
+        # wavefronts the buffer holds rows a-F+1..a and flushes 128-aligned
+        dbuf = pltpu.roll(dbuf, shift=FL - RB, axis=1)
+        dbuf = jnp.concatenate([dbuf[:, :FL - RB], packed], axis=1)
+
+        @pl.when(a % F == 0)
+        def _():
+            off = pl.multiple_of((a - F) * RB, 128)
+            dirs_ref[:, pl.ds(off, FL)] = dbuf.astype(jnp.uint8)
+
+        return v, v1, score, dbuf
+
+    _, _, score, _ = lax.fori_loop(1, S + 1, step, (v0, vm1, score0, dbuf0))
+    score_ref[:, :] = score
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
+def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
+                  steps: int = 0):
+    """Drop-in Pallas replacement for ``_nw_wavefront_kernel``: same
+    inputs, same packed direction matrix [B, steps, RB] and scores [B]
+    (``steps`` defaults to the full ``2*max_len`` sweep)."""
+    B, width = qrp.shape
+    U = band // 2
+    RB = U // 4
+    S = steps if steps else 2 * max_len
+    P = min(32, B)
+    qrp = jnp.pad(qrp, ((0, 0), (0, _LOAD_PAD)))
+    tp = jnp.pad(tp, ((0, 0), (0, _LOAD_PAD)))
+    kernel = functools.partial(_fwd_kernel, max_len=max_len, band=band,
+                               P=P, width=width, steps=S)
+    dirs, score = pl.pallas_call(
+        kernel,
+        grid=(B // P,),
+        in_specs=[
+            pl.BlockSpec((P, width + _LOAD_PAD), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, width + _LOAD_PAD), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((P, S * RB), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S * RB), jnp.uint8),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        ],
+    )(qrp, tp, n.reshape(B, 1).astype(jnp.int32),
+      m.reshape(B, 1).astype(jnp.int32))
+    return dirs.reshape(B, S, RB), score.reshape(B)
+
+
+def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
+                 buf, sems, *, band: int, P: int, C: int, steps: int):
+    W = band
+    c = W // 2
+    U = W // 2
+    RB = U // 4
+    S = steps
+    CHUNKS = S // C
+    WW = _rup(128 + RB, 128)   # byte-select window (row may straddle 128s)
+    blk = pl.program_id(0)
+    nn = n_ref[:, :]
+    mm = m_ref[:, :]
+    lane_ww = lax.broadcasted_iota(jnp.int32, (P, WW), 1)
+
+    def chunk_dma(slot, k):
+        # chunk k holds direction rows [S - (k+1)*C, S - k*C) — the walk
+        # consumes rows in descending-a order, so chunks stream backwards
+        lo = S - (k + 1) * C
+        return pltpu.make_async_copy(
+            dirs_ref.at[pl.ds(blk * P, P),
+                        pl.ds(pl.multiple_of(lo * RB, 128), C * RB)],
+            buf.at[slot, :, pl.ds(0, C * RB)],
+            sems.at[slot])
+
+    chunk_dma(0, 0).start()
+    # min(nn, 0) == 0 forces a row-varying carry layout (_fwd_kernel note)
+    obuf0 = jnp.full((P, 128), 3, jnp.int32) + jnp.minimum(nn, 0)
+
+    def chunk_body(k, carry):
+        i, j, obuf = carry
+        slot = k % 2
+
+        @pl.when(k + 1 < CHUNKS)
+        def _():
+            chunk_dma((k + 1) % 2, k + 1).start()
+
+        chunk_dma(slot, k).wait()
+        lo = S - (k + 1) * C
+
+        def step_body(s, carry):
+            i, j, obuf = carry                # (P, 1) positions before step
+            a = S - (k * C + s)               # global anti-diagonal, desc.
+            t = k * C + s                     # emitted step index, asc.
+            p = (a + c) & 1
+            u = (j - i + c - p) // 2
+            done = (i == 0) & (j == 0)
+            escaped = (i > 0) & (j > 0) & ((u < 0) | (u >= U))
+            active = ((i + j) == a) & ~done & ~escaped
+
+            # select each pair's direction byte from an aligned window of
+            # the chunk buffer (row offsets are RB-granular, so the row
+            # may straddle a 128-lane boundary — WW covers it)
+            uc = jnp.clip(u, 0, U - 1)
+            roff = (a - 1 - lo) * RB
+            rbase = pl.multiple_of((roff // 128) * 128, 128)
+            win = buf[slot, :, pl.ds(rbase, WW)]
+            bidx = (roff - rbase) + uc % RB
+            sel = jnp.sum(jnp.where(lane_ww == bidx,
+                                    win.astype(jnp.int32), 0),
+                          axis=1, keepdims=True)
+            d = (sel >> (2 * (uc // RB))) & 3
+            d = jnp.where(i == 0, 2, d)               # only D left
+            d = jnp.where((j == 0) & (i > 0), 1, d)   # only I left
+            op = jnp.where(active, d, 3)
+            di = jnp.where(active & (op != 2), 1, 0)  # M/I consume query
+            dj = jnp.where(active & (op != 1), 1, 0)  # M/D consume target
+
+            # rolling op buffer, flushed 128-aligned every 128 steps
+            obuf = pltpu.roll(obuf, shift=127, axis=1)
+            obuf = jnp.concatenate([obuf[:, :127], op], axis=1)
+
+            @pl.when((t + 1) % 128 == 0)
+            def _():
+                off = pl.multiple_of(t + 1 - 128, 128)
+                ops_ref[:, pl.ds(off, 128)] = obuf.astype(jnp.uint8)
+
+            return i - di, j - dj, obuf
+
+        return lax.fori_loop(0, C, step_body, (i, j, obuf))
+
+    fi, fj, _ = lax.fori_loop(0, CHUNKS, chunk_body, (nn, mm, obuf0))
+    fi_ref[:, :] = fi
+    fj_ref[:, :] = fj
+
+
+@functools.partial(jax.jit, static_argnames=("band",))
+def pallas_walk_ops(dirs, n, m, *, band: int):
+    """Wavefront-synchronized walk over the packed direction matrix.
+
+    Same (ops, fi, fj) contract as ``_walk_ops_kernel`` up to inactive-gap
+    placement (codes >= 3 interleave with the path after M steps); all
+    consumers mask on ``op < 3``.
+    """
+    B, S, RB = dirs.shape
+    P = min(32, B)
+    C = min(128, S)
+    kernel = functools.partial(_walk_kernel, band=band, P=P, C=C, steps=S)
+    ops, fi, fj = pl.pallas_call(
+        kernel,
+        grid=(B // P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((P, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S), jnp.uint8),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            # +WW tail lanes: the aligned byte-select window may read past
+            # the chunk's last row (reads are masked, never selected)
+            pltpu.VMEM((2, P, C * RB + _rup(128 + RB, 128)), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(dirs.reshape(B, S * RB), n.reshape(B, 1).astype(jnp.int32),
+      m.reshape(B, 1).astype(jnp.int32))
+    return ops, fi.reshape(B), fj.reshape(B)
+
+
+_PALLAS_OK = None
+
+
+def pallas_ok() -> bool:
+    """Probe once whether Mosaic kernels compile+run on this backend AND
+    reproduce the XLA reference kernels bit-for-bit on a random small
+    batch (True on real TPU; False on the CPU test mesh, which then uses
+    the XLA kernels). The value-level comparison matters: a Mosaic
+    regression that only corrupts values would otherwise ship silently —
+    tests pin JAX to CPU and never execute this path."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            import numpy as np
+            from .nw import _nw_wavefront_kernel, _walk_ops_kernel
+
+            max_len, band = 256, 128
+            B, c = 8, band // 2
+            width = c + max_len + band
+            rng = np.random.default_rng(7)
+            bases = np.frombuffer(b"ACGT", np.uint8)
+            qrp = np.full((B, width), 6, np.uint8)
+            tp = np.full((B, width), 7, np.uint8)
+            n = np.zeros(B, np.int32)
+            m = np.zeros(B, np.int32)
+            for k in range(B):
+                ln = int(rng.integers(60, 200))
+                t = bases[rng.integers(0, 4, ln)]
+                q = np.delete(t.copy(), rng.integers(0, ln, 4))
+                flips = rng.random(len(q)) < 0.2
+                q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+                qrp[k, c + max_len - len(q): c + max_len] = q[::-1]
+                tp[k, c: c + ln] = t
+                n[k], m[k] = len(q), ln
+            args = (jnp.asarray(qrp), jnp.asarray(tp),
+                    jnp.asarray(n), jnp.asarray(m))
+            dp, sp = pallas_nw_fwd(*args, max_len=max_len, band=band)
+            dx, sx = _nw_wavefront_kernel(*args, max_len=max_len, band=band)
+            op_, fip, fjp = pallas_walk_ops(dp, args[2], args[3],
+                                            band=band)
+            ox, fix, fjx = _walk_ops_kernel(dx, args[2], args[3],
+                                            band=band)
+            dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx = map(
+                np.asarray, (dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx))
+            _PALLAS_OK = (
+                np.array_equal(dp, dx) and np.array_equal(sp, sx)
+                and np.array_equal(fip, fix) and np.array_equal(fjp, fjx)
+                and all(np.array_equal(op_[k][op_[k] < 3], ox[k][ox[k] < 3])
+                        for k in range(B)))
+        except Exception:
+            _PALLAS_OK = False
+    return _PALLAS_OK

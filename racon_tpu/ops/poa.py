@@ -203,11 +203,13 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
 
 
 @functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
-                                             "Lb", "K"))
+                                             "Lb", "K", "steps",
+                                             "use_pallas"))
 def refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
                  bcodes, bweights, blen, covs, ever, frozen, dropped,
                  ins_theta, del_beta, *, n_windows: int, max_len: int,
-                 band: int, Lb: int, K: int):
+                 band: int, Lb: int, K: int, steps: int = 0,
+                 use_pallas: bool = False):
     """One fully-device-resident refinement round.
 
     Align every layer against its current backbone span, vote, pick
@@ -239,9 +241,16 @@ def refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
     tval = jnp.take(bcodes.reshape(-1), flat_src)
     tp = jnp.where((cols >= 0) & (cols < m[:, None]), tval, jnp.uint8(T_PAD))
 
-    packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
-                                         max_len=Lq, band=band)
-    ops, fi, fj = _walk_ops_kernel(packed, n, m, max_len=Lq, band=band)
+    if use_pallas:
+        from .pallas_nw import pallas_nw_fwd, pallas_walk_ops
+        packed, score = pallas_nw_fwd(qrp, tp, n, m,
+                                      max_len=Lq, band=band, steps=steps)
+        ops, fi, fj = pallas_walk_ops(packed, n, m, band=band)
+    else:
+        packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
+                                             max_len=Lq, band=band,
+                                             steps=steps)
+        ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band)
     weighted, unweighted, okp = _vote_from_ops(
         ops, fi, fj, score, n, m, qcodes, qweights, bg, win_of,
         n_windows=n_windows, max_len=Lq, band=band, L=Lb, K=K)
@@ -395,6 +404,14 @@ class TpuPoaConsensus:
                     and len(w.backbone) <= Lb]
 
         if live:
+            # anti-diagonal sweep bound: longest real pair plus span-growth
+            # slack, rounded to 256 (dead wavefronts past the last finish
+            # are pure waste; a span that outgrows the slack drops that
+            # pair's votes for the round, like a band escape)
+            max_nm = max(
+                len(s) + min((e - b + 1) + 64, Lb)
+                for _, w in live for s, _, b, e in w.layers)
+            steps = min(-(-max_nm // 256) * 256, 2 * Lq)
             from ..parallel import partition_balanced
             if self.num_batches == 1:
                 groups = [list(live)]
@@ -402,10 +419,11 @@ class TpuPoaConsensus:
                 bins = partition_balanced([len(w.layers) for _, w in live],
                                           self.num_batches)
                 groups = [[live[i] for i in b] for b in bins if b]
-            launches = [self._launch_group(g, Lq, Lb) for g in groups]
+            launches = [self._launch_group(g, Lq, Lb, steps)
+                        for g in groups]
             for rnd in range(self.rounds):
                 for la in launches:
-                    self._round(la, Lq, Lb)
+                    self._round(la, Lq, Lb, steps)
                 if progress is not None:
                     # bar units = dispatched refinement rounds (+1 for the
                     # fetch/stitch/fallback tail): rounds are dispatched
@@ -485,7 +503,7 @@ class TpuPoaConsensus:
         return (qrp, n, qcodes, qweights, win_of, real, bg, ed), \
                (bcodes, bweights, blen)
 
-    def _launch_group(self, live, Lq, Lb):
+    def _launch_group(self, live, Lq, Lb, steps):
         """Pack one window group (per-mesh-shard when a mesh is set — pairs
         of a window never cross shards, so votes stay shard-local) into the
         device-resident refinement state."""
@@ -522,8 +540,33 @@ class TpuPoaConsensus:
         return {"shards": shards, "static": static, "state": state,
                 "nWp": nWp, "nd": nd}
 
-    def _round(self, launch, Lq, Lb) -> None:
-        """Dispatch one refinement round for a group (no host sync)."""
+    _pallas_disabled = False
+
+    def _use_pallas(self) -> bool:
+        if self._pallas_disabled:
+            return False
+        from .pallas_nw import pallas_ok
+        return pallas_ok()
+
+    def _round(self, launch, Lq, Lb, steps) -> None:
+        """Dispatch one refinement round for a group (no host sync).
+
+        The Pallas availability probe runs at one small shape, so a Mosaic
+        compile failure at the production shape (e.g. an exotic band or a
+        VMEM overflow) is still possible — it surfaces synchronously at
+        dispatch, and we fall back to the XLA kernels for the rest of the
+        run instead of aborting the polish (jit compilation is eager, so
+        only compile errors are catchable here; numerics are covered by
+        the probe's bit-exact comparison)."""
+        if self._use_pallas():
+            try:
+                self._dispatch_round(launch, Lq, Lb, steps, True)
+                return
+            except Exception:
+                self._pallas_disabled = True
+        self._dispatch_round(launch, Lq, Lb, steps, False)
+
+    def _dispatch_round(self, launch, Lq, Lb, steps, use_pallas) -> None:
         static, state = launch["static"], launch["state"]
         theta = jnp.float32(self.ins_theta)
         beta = jnp.float32(self.del_beta)
@@ -531,13 +574,13 @@ class TpuPoaConsensus:
             out = refine_round(
                 *static, *state, theta, beta,
                 n_windows=launch["nWp"], max_len=Lq, band=self.band,
-                Lb=Lb, K=K_INS)
+                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas)
         else:
             from ..parallel import sharded_refine_round
             out = sharded_refine_round(
                 self.mesh, static, state, theta, beta,
                 n_windows_local=launch["nWp"], max_len=Lq, band=self.band,
-                Lb=Lb, K=K_INS)
+                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas)
         launch["state"] = list(out)
 
     def _finish_group(self, launch, trim: bool, results) -> None:

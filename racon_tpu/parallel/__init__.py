@@ -95,7 +95,8 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int):
 
 @functools.lru_cache(maxsize=None)
 def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
-                       band: int, Lb: int, K: int):
+                       band: int, Lb: int, K: int, steps: int,
+                       use_pallas: bool):
     from ..ops.poa import refine_round
 
     def local(qrp, n, qcodes, qweights, win_of, real, bg, ed,
@@ -105,7 +106,8 @@ def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
                             bcodes, bweights, blen, covs, ever, frozen,
                             dropped, ins_theta, del_beta,
                             n_windows=n_windows_local, max_len=max_len,
-                            band=band, Lb=Lb, K=K)
+                            band=band, Lb=Lb, K=K, steps=steps,
+                            use_pallas=use_pallas)
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(
@@ -115,7 +117,8 @@ def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
 
 def sharded_refine_round(mesh: Mesh, static, state, ins_theta, del_beta, *,
                          n_windows_local: int, max_len: int, band: int,
-                         Lb: int, K: int):
+                         Lb: int, K: int, steps: int = 0,
+                         use_pallas: bool = False):
     """One device-resident refinement round over a co-sharded batch.
 
     ``static`` = (qrp, n, qcodes, qweights, win_of, real) with leading dim
@@ -129,5 +132,6 @@ def sharded_refine_round(mesh: Mesh, static, state, ins_theta, del_beta, *,
     whole refinement loop scales collective-free.  Returns the updated
     ``state`` stacked the same way.
     """
-    fn = _sharded_refine_fn(mesh, n_windows_local, max_len, band, Lb, K)
+    fn = _sharded_refine_fn(mesh, n_windows_local, max_len, band, Lb, K,
+                            steps, use_pallas)
     return fn(*static, *state, ins_theta, del_beta)
