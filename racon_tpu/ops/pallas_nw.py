@@ -172,6 +172,13 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     RB = U // 4
     S = steps if steps else 2 * max_len
     P = min(32, B)
+    FL = RB
+    while FL % 128:
+        FL += RB
+    if S % (FL // RB):
+        raise ValueError(
+            f"steps={S} must divide the dirs flush period {FL // RB} "
+            f"(band={band}); round steps up to a multiple of 256")
     qrp = jnp.pad(qrp, ((0, 0), (0, _LOAD_PAD)))
     tp = jnp.pad(tp, ((0, 0), (0, _LOAD_PAD)))
     kernel = functools.partial(_fwd_kernel, max_len=max_len, band=band,
@@ -201,6 +208,50 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     return dirs.reshape(B, S, RB), score.reshape(B)
 
 
+
+def _chunk_dma_factory(dirs_ref, buf, sems, blk, *, P, C, RB, S):
+    """Double-buffered descending-a chunk DMA: chunk k holds direction
+    rows [S - (k+1)*C, S - k*C) — the walk consumes rows backwards."""
+    def chunk_dma(slot, k):
+        lo = S - (k + 1) * C
+        return pltpu.make_async_copy(
+            dirs_ref.at[pl.ds(blk * P, P),
+                        pl.ds(pl.multiple_of(lo * RB, 128), C * RB)],
+            buf.at[slot, :, pl.ds(0, C * RB)],
+            sems.at[slot])
+    return chunk_dma
+
+
+def _walk_step_decode(buf, slot, lo, a, i, j, lane_ww, *, c, U, RB, WW):
+    """One wavefront-synchronized walk step, shared by the plain walk and
+    the fused walk+vote kernel (the trickiest logic in this file — keep
+    one copy): decode the pair's direction byte from an aligned window of
+    the chunk buffer, apply boundary overrides, and gate on activity.
+    Returns (op, di, dj, active) as (P, 1) vectors."""
+    p = (a + c) & 1
+    u = (j - i + c - p) // 2
+    done = (i == 0) & (j == 0)
+    escaped = (i > 0) & (j > 0) & ((u < 0) | (u >= U))
+    active = ((i + j) == a) & ~done & ~escaped
+
+    # the row may straddle a 128-lane boundary (offsets are RB-granular);
+    # WW covers it, masked tail reads are never selected
+    uc = jnp.clip(u, 0, U - 1)
+    roff = (a - 1 - lo) * RB
+    rbase = pl.multiple_of((roff // 128) * 128, 128)
+    win = buf[slot, :, pl.ds(rbase, WW)]
+    bidx = (roff - rbase) + uc % RB
+    sel = jnp.sum(jnp.where(lane_ww == bidx, win.astype(jnp.int32), 0),
+                  axis=1, keepdims=True)
+    d = (sel >> (2 * (uc // RB))) & 3
+    d = jnp.where(i == 0, 2, d)               # only D left
+    d = jnp.where((j == 0) & (i > 0), 1, d)   # only I left
+    op = jnp.where(active, d, 3)
+    di = jnp.where(active & (op != 2), 1, 0)  # M/I consume query
+    dj = jnp.where(active & (op != 1), 1, 0)  # M/D consume target
+    return op, di, dj, active
+
+
 def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
                  buf, sems, *, band: int, P: int, C: int, steps: int):
     W = band
@@ -214,16 +265,8 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
     nn = n_ref[:, :]
     mm = m_ref[:, :]
     lane_ww = lax.broadcasted_iota(jnp.int32, (P, WW), 1)
-
-    def chunk_dma(slot, k):
-        # chunk k holds direction rows [S - (k+1)*C, S - k*C) — the walk
-        # consumes rows in descending-a order, so chunks stream backwards
-        lo = S - (k + 1) * C
-        return pltpu.make_async_copy(
-            dirs_ref.at[pl.ds(blk * P, P),
-                        pl.ds(pl.multiple_of(lo * RB, 128), C * RB)],
-            buf.at[slot, :, pl.ds(0, C * RB)],
-            sems.at[slot])
+    chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
+                                   P=P, C=C, RB=RB, S=S)
 
     chunk_dma(0, 0).start()
     # min(nn, 0) == 0 forces a row-varying carry layout (_fwd_kernel note)
@@ -244,29 +287,9 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
             i, j, obuf = carry                # (P, 1) positions before step
             a = S - (k * C + s)               # global anti-diagonal, desc.
             t = k * C + s                     # emitted step index, asc.
-            p = (a + c) & 1
-            u = (j - i + c - p) // 2
-            done = (i == 0) & (j == 0)
-            escaped = (i > 0) & (j > 0) & ((u < 0) | (u >= U))
-            active = ((i + j) == a) & ~done & ~escaped
-
-            # select each pair's direction byte from an aligned window of
-            # the chunk buffer (row offsets are RB-granular, so the row
-            # may straddle a 128-lane boundary — WW covers it)
-            uc = jnp.clip(u, 0, U - 1)
-            roff = (a - 1 - lo) * RB
-            rbase = pl.multiple_of((roff // 128) * 128, 128)
-            win = buf[slot, :, pl.ds(rbase, WW)]
-            bidx = (roff - rbase) + uc % RB
-            sel = jnp.sum(jnp.where(lane_ww == bidx,
-                                    win.astype(jnp.int32), 0),
-                          axis=1, keepdims=True)
-            d = (sel >> (2 * (uc // RB))) & 3
-            d = jnp.where(i == 0, 2, d)               # only D left
-            d = jnp.where((j == 0) & (i > 0), 1, d)   # only I left
-            op = jnp.where(active, d, 3)
-            di = jnp.where(active & (op != 2), 1, 0)  # M/I consume query
-            dj = jnp.where(active & (op != 1), 1, 0)  # M/D consume target
+            op, di, dj, _ = _walk_step_decode(buf, slot, lo, a, i, j,
+                                              lane_ww, c=c, U=U, RB=RB,
+                                              WW=WW)
 
             # rolling op buffer, flushed 128-aligned every 128 steps
             obuf = pltpu.roll(obuf, shift=127, axis=1)
@@ -297,6 +320,10 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
     B, S, RB = dirs.shape
     P = min(32, B)
     C = min(128, S)
+    if S % C:
+        raise ValueError(
+            f"steps={S} must be a multiple of the walk chunk ({C}); "
+            f"round steps up to a multiple of 256")
     kernel = functools.partial(_walk_kernel, band=band, P=P, C=C, steps=S)
     ops, fi, fj = pl.pallas_call(
         kernel,
@@ -371,11 +398,188 @@ def pallas_ok() -> bool:
                                             band=band)
             dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx = map(
                 np.asarray, (dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx))
-            _PALLAS_OK = (
+            ok = (
                 np.array_equal(dp, dx) and np.array_equal(sp, sx)
                 and np.array_equal(fip, fix) and np.array_equal(fjp, fjx)
                 and all(np.array_equal(op_[k][op_[k] < 3], ox[k][ox[k] < 3])
                         for k in range(B)))
+
+            # fused walk+vote path must land on identical vote matrices
+            if ok:
+                from .poa import (CH, DEL, _scatter_votes, _vote_from_ops)
+                L, K, nW = max_len, 4, 4
+                qcodes = jnp.asarray(
+                    rng.integers(0, 5, (B, max_len)).astype(np.uint8))
+                qweights = jnp.asarray(
+                    rng.integers(0, 60, (B, max_len)).astype(np.uint8))
+                bg = jnp.asarray(rng.integers(0, 8, B).astype(np.int32))
+                win_of = jnp.asarray(
+                    (np.arange(B) % (nW - 1)).astype(np.int32))
+                wx, ux, okx = _vote_from_ops(
+                    jnp.asarray(ox), jnp.asarray(fix), jnp.asarray(fjx),
+                    jnp.asarray(sx), args[2], args[3], qcodes, qweights,
+                    bg, win_of, n_windows=nW, max_len=max_len, band=band,
+                    L=L, K=K)
+                idx, w8, fiv, fjv = pallas_walk_vote(
+                    jnp.asarray(dp), args[2], args[3], bg, qcodes,
+                    qweights, band=band, L=L, K=K, CH=CH, DEL=DEL)
+                okv = ((fiv == 0) & (fjv == 0)
+                       & (jnp.asarray(sp) < (band // 2)))
+                wp, up = _scatter_votes(idx, w8, okv, win_of,
+                                        n_windows=nW, VOT=L * (1 + K) * CH)
+                ok = (np.array_equal(np.asarray(wx), np.asarray(wp))
+                      and np.array_equal(np.asarray(ux), np.asarray(up)))
+            _PALLAS_OK = ok
         except Exception:
             _PALLAS_OK = False
     return _PALLAS_OK
+
+
+def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
+                      idx_ref, w_ref, fi_ref, fj_ref, buf, sems, *,
+                      band: int, P: int, C: int, steps: int, Lq: int,
+                      L: int, K: int, CH: int, DEL: int):
+    """Fused walk + vote emission for the consensus engine.
+
+    Same traversal as ``_walk_kernel`` (shared ``_walk_step_decode``), but
+    instead of op codes it emits each step's vote address (``idx``,
+    column/insertion-slot layout of ``ops.poa._vote_from_ops``; the sink
+    ``VOT`` when invalid) and its quality weight — the walk already holds
+    (i, j, op) and the insertion-run counter in registers, so the
+    XLA-side [B, S] prefix-sum reconstruction (two cumsums, a cummax, two
+    batched gathers) disappears entirely; the XLA side only folds in
+    ``win_of``, applies the per-pair ``ok`` gate, and scatter-adds.
+
+    The layer base/weight lookups are per-pair masked max-reduces over the
+    (P, Lq) query rows held in VMEM (only one lane matches ``i - 1``, so
+    max == select; weights are integral 0..93 and travel as uint8).
+    """
+    W = band
+    c = W // 2
+    U = W // 2
+    RB = U // 4
+    S = steps
+    VOT = L * (1 + K) * CH
+    CHUNKS = S // C
+    WW = _rup(128 + RB, 128)
+    blk = pl.program_id(0)
+    nn = n_ref[:, :]
+    mm = m_ref[:, :]
+    bg = bg_ref[:, :]
+    # i32 views for the per-step selects (Mosaic only reduces i32/f32)
+    qcv = qc_ref[:, :].astype(jnp.int32)   # (P, Lq)
+    qwv = qw_ref[:, :].astype(jnp.int32)
+    lane_ww = lax.broadcasted_iota(jnp.int32, (P, WW), 1)
+    lane_q = lax.broadcasted_iota(jnp.int32, (P, Lq), 1)
+    chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
+                                   P=P, C=C, RB=RB, S=S)
+
+    chunk_dma(0, 0).start()
+    zrow = jnp.minimum(nn, 0)
+    ibuf0 = jnp.full((P, 128), VOT, jnp.int32) + zrow
+    wbuf0 = jnp.zeros((P, 128), jnp.int32) + zrow
+
+    def chunk_body(k, carry):
+        i, j, run, ibuf, wbuf = carry
+        slot = k % 2
+
+        @pl.when(k + 1 < CHUNKS)
+        def _():
+            chunk_dma((k + 1) % 2, k + 1).start()
+
+        chunk_dma(slot, k).wait()
+        lo = S - (k + 1) * C
+
+        def step_body(s, carry):
+            i, j, run, ibuf, wbuf = carry
+            a = S - (k * C + s)
+            t = k * C + s
+            op, di, dj, active = _walk_step_decode(buf, slot, lo, a, i, j,
+                                                   lane_ww, c=c, U=U,
+                                                   RB=RB, WW=WW)
+
+            # layer base code + weight at query position i-1 (clipped like
+            # the XLA path; a single lane matches, so max == select)
+            qmask = lane_q == jnp.clip(i - 1, 0, Lq - 1)
+            base = jnp.max(jnp.where(qmask, qcv, 0), axis=1, keepdims=True)
+            wq = jnp.max(jnp.where(qmask, qwv, 0), axis=1, keepdims=True)
+
+            slot_i = jnp.minimum(run, K - 1)
+            col = bg + j - 1
+            addr = jnp.where(
+                op == 0, col * CH + base,
+                jnp.where(op == 2, col * CH + DEL,
+                          (L + col * K + slot_i) * CH + base))
+            valid = active & (j >= 1) & (col >= 0) & (col < L)
+            addr = jnp.where(valid, addr, VOT)
+            wv = jnp.where(valid, wq, 0)
+            run = jnp.where(active, jnp.where(op == 1, run + 1, 0), run)
+
+            ibuf = pltpu.roll(ibuf, shift=127, axis=1)
+            ibuf = jnp.concatenate([ibuf[:, :127], addr], axis=1)
+            wbuf = pltpu.roll(wbuf, shift=127, axis=1)
+            wbuf = jnp.concatenate([wbuf[:, :127], wv], axis=1)
+
+            @pl.when((t + 1) % 128 == 0)
+            def _():
+                off = pl.multiple_of(t + 1 - 128, 128)
+                idx_ref[:, pl.ds(off, 128)] = ibuf
+                w_ref[:, pl.ds(off, 128)] = wbuf.astype(jnp.uint8)
+
+            return i - di, j - dj, run, ibuf, wbuf
+
+        return lax.fori_loop(0, C, step_body, (i, j, run, ibuf, wbuf))
+
+    fi, fj, _, _, _ = lax.fori_loop(
+        0, CHUNKS, chunk_body, (nn, mm, zrow, ibuf0, wbuf0))
+    fi_ref[:, :] = fi
+    fj_ref[:, :] = fj
+
+
+@functools.partial(jax.jit, static_argnames=("band", "L", "K", "CH", "DEL"))
+def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
+                     L: int, K: int, CH: int, DEL: int):
+    """Fused walk + vote emission. Returns (idx [B,S] i32 — vote address
+    or the sink VOT, w [B,S] u8, fi, fj). Replaces ``pallas_walk_ops`` +
+    the XLA prefix-sum vote prep on the consensus path."""
+    B, S, RB = dirs.shape
+    Lq = qcodes.shape[1]
+    P = min(32, B)
+    C = min(128, S)
+    if S % C:
+        raise ValueError(
+            f"steps={S} must be a multiple of the walk chunk ({C}); "
+            f"round steps up to a multiple of 256")
+    kernel = functools.partial(_walk_vote_kernel, band=band, P=P, C=C,
+                               steps=S, Lq=Lq, L=L, K=K, CH=CH, DEL=DEL)
+    idx, w, fi, fj = pl.pallas_call(
+        kernel,
+        grid=(B // P,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, Lq), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, Lq), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((P, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S), jnp.int32),
+            jax.ShapeDtypeStruct((B, S), jnp.uint8),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+            jax.ShapeDtypeStruct((B, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, P, C * RB + _rup(128 + RB, 128)), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(dirs.reshape(B, S * RB), n.reshape(B, 1).astype(jnp.int32),
+      m.reshape(B, 1).astype(jnp.int32),
+      bg.reshape(B, 1).astype(jnp.int32), qcodes, qweights_u8)
+    return idx, w, fi.reshape(B), fj.reshape(B)
