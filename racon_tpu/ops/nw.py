@@ -46,9 +46,12 @@ BUCKETS: Tuple[Tuple[int, int], ...] = (
 # Expected divergence used to pick the initial band (escalation corrects
 # underestimates; ONT reads of the reference's era run 15-30%).
 TYPICAL_DIVERGENCE = 0.25
-# Upper bound on the packed direction-matrix bytes held per device batch
-# (v5e has 16 GiB HBM; the matrix never leaves the device).
-MAX_DIRS_BYTES = 1536 * 1024 * 1024
+# Upper bound on the packed direction-matrix bytes held across in-flight
+# device batches (v5e has 16 GiB HBM; the matrix never leaves the
+# device). Small caps fragment long-bucket batches into many chunks, and
+# each chunk pays a dispatch round-trip — 4 GiB keeps 2-8 kbp overlap
+# batches in a handful of chunks.
+MAX_DIRS_BYTES = 4 * 1024 * 1024 * 1024
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
 def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
@@ -212,33 +215,99 @@ def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
     """Aligner-facing traceback: walks on device, then packs the op codes
     2-bit x 4-per-byte so one host round-trip fetches everything (the
     tunnel to the device has ~0.2s per-transfer latency)."""
-    B, S = packed.shape[0], packed.shape[1]
     ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band)
+    return _pack_ops(ops), score, fi, fj
+
+
+def _pack_ops(ops):
+    """2-bit x 4-per-byte op packing for the host fetch (one consumer:
+    ``TpuAligner._finish_chunk``'s unpacker)."""
+    B, S = ops.shape
     o4 = ops.reshape(B, S // 4, 4)
-    ops_packed = (o4[:, :, 0] | (o4[:, :, 1] << 2) | (o4[:, :, 2] << 4)
-                  | (o4[:, :, 3] << 6))
-    return ops_packed, score, fi, fj
+    return (o4[:, :, 0] | (o4[:, :, 1] << 2) | (o4[:, :, 2] << 4)
+            | (o4[:, :, 3] << 6))
 
 
-def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0):
+def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0,
+                use_pallas: bool = False):
     """Wavefront NW + on-device traceback — the single source of truth for
     the aligner's kernel wiring, wrapped unchanged by both the plain path
     (``TpuAligner._run_chunk``) and the ``shard_map`` path
-    (``racon_tpu.parallel.sharded_align``)."""
+    (``racon_tpu.parallel.sharded_align``). With ``use_pallas`` the
+    VMEM-resident Mosaic kernels produce the identical direction matrix
+    and (gap-interleaved) op codes."""
+    if use_pallas:
+        from .pallas_nw import pallas_nw_fwd, pallas_walk_ops
+        packed, score = pallas_nw_fwd(qrp, tp, n, m, max_len=max_len,
+                                      band=band, steps=steps)
+        ops, fi, fj = pallas_walk_ops(packed, n, m, band=band)
+        return _pack_ops(ops), score, fi, fj
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                          max_len=max_len, band=band,
                                          steps=steps)
     return _traceback_kernel(packed, score, n, m, max_len=max_len, band=band)
 
 
-def _ops_to_cigar(ops: np.ndarray, path_len: int) -> str:
-    """Run-length encode reversed device op codes into a CIGAR string."""
-    arr = ops[:path_len][::-1]
-    if path_len == 0:
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def _build_rows(qcat, tcat, n, m, *, max_len: int, band: int):
+    """Build the banded NW row layout on device from dense byte blocks
+    (pair k's query/target at ``k * max_len``): qrp holds the reversed
+    query ending at column ``c + max_len``, tp the forward target at
+    offset ``c`` — exactly the layout the host used to pack."""
+    B = n.shape[0]
+    c = band // 2
+    width = c + max_len + band
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    row0 = (jnp.arange(B, dtype=jnp.int32) * max_len)[:, None]
+
+    qoff = c + max_len - 1 - pos  # reversed: column c+j holds q[n-1-j']...
+    qvalid = (qoff >= 0) & (qoff < n[:, None])
+    qsrc = row0 + jnp.clip(qoff, 0, max_len - 1)
+    qrp = jnp.where(qvalid, jnp.take(qcat, qsrc.reshape(-1)
+                                     ).reshape(B, width), jnp.uint8(0))
+
+    toff = pos - c
+    tvalid = (toff >= 0) & (toff < m[:, None])
+    tsrc = row0 + jnp.clip(toff, 0, max_len - 1)
+    tp = jnp.where(tvalid, jnp.take(tcat, tsrc.reshape(-1)
+                                    ).reshape(B, width), jnp.uint8(0))
+    return qrp, tp
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
+    """``_build_rows`` over nibble-packed inputs (two 4-bit codes per
+    byte; code 0 is padding). Unpacking is a shift/mask on the gathered
+    byte, so the wide row arrays never cross the host link."""
+    B = n.shape[0]
+    c = band // 2
+    width = c + max_len + band
+    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
+    row0 = (jnp.arange(B, dtype=jnp.int32) * max_len)[:, None]
+
+    def unpack(cat4, off, valid):
+        src = row0 + jnp.clip(off, 0, max_len - 1)
+        byte = jnp.take(cat4, (src // 2).reshape(-1)).reshape(B, width)
+        code = (byte >> ((src % 2) * 4).astype(jnp.uint8)) & 0xF
+        return jnp.where(valid, code.astype(jnp.uint8), jnp.uint8(0))
+
+    qoff = c + max_len - 1 - pos
+    qrp = unpack(q4, qoff, (qoff >= 0) & (qoff < n[:, None]))
+    toff = pos - c
+    tp = unpack(t4, toff, (toff >= 0) & (toff < m[:, None]))
+    return qrp, tp
+
+
+def _ops_to_cigar(path: np.ndarray) -> str:
+    """Run-length encode a backward-order op path into a CIGAR string
+    (callers pre-filter ``ops < 3`` — the Pallas walk interleaves
+    inactive-gap codes after M steps, the XLA walk only trails them)."""
+    if len(path) == 0:
         return ""
+    arr = path[::-1]
     change = np.flatnonzero(np.diff(arr)) + 1
     starts = np.concatenate(([0], change))
-    ends = np.concatenate((change, [path_len]))
+    ends = np.concatenate((change, [len(arr)]))
     sym = {0: "M", 1: "I", 2: "D"}
     return "".join(f"{e - s}{sym[int(arr[s])]}" for s, e in zip(starts, ends))
 
@@ -318,63 +387,73 @@ class TpuAligner:
         self.stats["fallback_length"] += len(reject)
 
         # Band escapes retry on device with the next (wider-band) bucket —
-        # the analog of the reference host's band-doubling, but batched;
-        # only escapes from the widest bucket go to the host fallback.
+        # the analog of the reference host's band-doubling, but batched.
+        # All buckets of a wave share one in-flight window (num_batches
+        # deep): with num_batches > 1, chunk k+1 of any bucket is packed
+        # and dispatched while chunk k computes, hiding the tunnel's
+        # ~0.3s per-fetch round-trip; escape handling is batched per wave
+        # either way. Only escapes from the widest bucket go to the host
+        # fallback.
+        from ..parallel import mesh_size
         while by_bucket:
-            bi = min(by_bucket)
-            indices = by_bucket.pop(bi)
-            max_len, band = self.buckets[bi]
-            raw_cap = (self.max_dirs_bytes // self.num_batches
-                       ) // (max_len * (band // 4))
-            # chunks pad to mesh_size * 2^k (see _pad_batch), so cap at the
-            # largest such size to keep the memory bound honest
-            from ..parallel import mesh_size
-            batch_cap = mesh_size(self.mesh)
-            if batch_cap > max(1, raw_cap):
-                import warnings
-                warnings.warn(
-                    f"mesh size {batch_cap} exceeds the direction-matrix "
-                    f"memory budget ({raw_cap} pairs of bucket "
-                    f"({max_len},{band}) fit in "
-                    f"{self.max_dirs_bytes // self.num_batches} bytes); "
-                    f"lower num_batches or use a smaller mesh",
-                    RuntimeWarning)
-            while batch_cap * 2 <= raw_cap:
-                batch_cap *= 2
-            escaped: List[int] = []
-            # pipelined dispatch: keep num_batches chunks in flight so the
-            # host packs chunk k+1 while the device computes chunk k
-            # (reference analog: per-batch fill/process loops on pool
-            # threads, cudapolisher.cpp:98-160)
             inflight = []
-            for start in range(0, len(indices), batch_cap):
-                chunk = indices[start:start + batch_cap]
-                inflight.append(self._launch_chunk(pairs, chunk,
-                                                   max_len, band))
-                if len(inflight) >= self.num_batches:
-                    n_chunk = len(inflight[0][0])
-                    n_esc = len(escaped)
-                    self._finish_chunk(inflight.pop(0), band, cigars,
-                                       escaped)
-                    done_pairs += n_chunk - (len(escaped) - n_esc)
-                    if progress is not None:
-                        progress(done_pairs, len(pairs))
+            escaped = {}  # bucket -> indices that escaped its band
+            for bi in sorted(by_bucket):
+                indices = by_bucket[bi]
+                max_len, band = self.buckets[bi]
+                raw_cap = (self.max_dirs_bytes // self.num_batches
+                           ) // (max_len * (band // 4))
+                # chunks pad to mesh_size * 2^k (see _pad_batch), so cap
+                # at the largest such size to keep the memory bound honest
+                batch_cap = mesh_size(self.mesh)
+                if batch_cap > max(1, raw_cap):
+                    import warnings
+                    warnings.warn(
+                        f"mesh size {batch_cap} exceeds the direction-"
+                        f"matrix memory budget ({raw_cap} pairs of bucket "
+                        f"({max_len},{band}) fit in "
+                        f"{self.max_dirs_bytes // self.num_batches} "
+                        f"bytes); lower num_batches or use a smaller mesh",
+                        RuntimeWarning)
+                while batch_cap * 2 <= raw_cap:
+                    batch_cap *= 2
+                esc = escaped.setdefault(bi, [])
+                # keep num_batches chunks in flight so the host packs
+                # chunk k+1 while the device computes chunk k (reference
+                # analog: per-batch fill/process loops on pool threads,
+                # cudapolisher.cpp:98-160)
+                for start in range(0, len(indices), batch_cap):
+                    chunk = indices[start:start + batch_cap]
+                    inflight.append(
+                        (band, esc, self._launch_chunk(pairs, chunk,
+                                                       max_len, band)))
+                    if len(inflight) >= self.num_batches:
+                        band0, esc0, launched = inflight.pop(0)
+                        n_chunk = len(launched[0])
+                        n_esc = len(esc0)
+                        self._finish_chunk(launched, band0, cigars, esc0)
+                        done_pairs += n_chunk - (len(esc0) - n_esc)
+                        if progress is not None:
+                            progress(done_pairs, len(pairs))
             while inflight:
-                n_chunk = len(inflight[0][0])
-                n_esc = len(escaped)
-                self._finish_chunk(inflight.pop(0), band, cigars, escaped)
-                done_pairs += n_chunk - (len(escaped) - n_esc)
+                band0, esc0, launched = inflight.pop(0)
+                n_chunk = len(launched[0])
+                n_esc = len(esc0)
+                self._finish_chunk(launched, band0, cigars, esc0)
+                done_pairs += n_chunk - (len(esc0) - n_esc)
                 if progress is not None:
                     progress(done_pairs, len(pairs))
-            for idx in escaped:
-                q, t = pairs[idx]
-                nbi = self._bucket_index(len(q), len(t), bi + 1)
-                if nbi is None:
-                    self.stats["fallback_band"] += 1
-                    reject.append(idx)
-                else:
-                    self.stats["band_escalated"] += 1
-                    by_bucket.setdefault(nbi, []).append(idx)
+            by_bucket = {}
+            for bi, idxs in escaped.items():
+                for idx in idxs:
+                    q, t = pairs[idx]
+                    nbi = self._bucket_index(len(q), len(t), bi + 1)
+                    if nbi is None:
+                        self.stats["fallback_band"] += 1
+                        reject.append(idx)
+                    else:
+                        self.stats["band_escalated"] += 1
+                        by_bucket.setdefault(nbi, []).append(idx)
 
         if reject:
             if self.fallback is None:
@@ -387,35 +466,90 @@ class TpuAligner:
             progress(len(pairs), len(pairs))
         return cigars
 
+    _pallas_disabled = False
+
+    def _use_pallas(self) -> bool:
+        if self._pallas_disabled:
+            return False
+        from .pallas_nw import pallas_ok
+        return pallas_ok()
+
     def _launch_chunk(self, pairs, chunk, max_len, band):
         """Pack a chunk and dispatch its kernels; returns the in-flight
         handle consumed by ``_finish_chunk``. Device work proceeds
-        asynchronously after dispatch."""
+        asynchronously after dispatch.
+
+        Sequences cross the host link as dense ``B * max_len`` byte
+        blocks; the banded row layout (reversal, band offsets, padding) is
+        built on device (:func:`_build_rows`) — the padded row arrays are
+        ~3x the raw bases, and the tunnel is bandwidth-starved."""
         # Pad the batch to a power of two: B is part of the compiled shape,
         # so arbitrary batch sizes would recompile the kernels every call.
         B = self._pad_batch(len(chunk))
-        c = band // 2
-        width = c + max_len + band
-        qrp = np.zeros((B, width), dtype=np.uint8)
-        tp = np.zeros((B, width), dtype=np.uint8)
+        qcat = np.zeros(B * max_len, dtype=np.uint8)
+        tcat = np.zeros(B * max_len, dtype=np.uint8)
         n = np.ones(B, dtype=np.int32)
         m = np.ones(B, dtype=np.int32)
         for k, idx in enumerate(chunk):
             qb, tb = pairs[idx]
-            qrp[k, c + max_len - len(qb): c + max_len] = \
-                np.frombuffer(qb, dtype=np.uint8)[::-1]
-            tp[k, c: c + len(tb)] = np.frombuffer(tb, dtype=np.uint8)
+            qcat[k * max_len: k * max_len + len(qb)] = \
+                np.frombuffer(qb, dtype=np.uint8)
+            tcat[k * max_len: k * max_len + len(tb)] = \
+                np.frombuffer(tb, dtype=np.uint8)
             n[k], m[k] = len(qb), len(tb)
 
+        # sweep bound: the longest real pair, rounded coarsely (1024 for
+        # long buckets) so the per-chunk shape stays compile-cache-friendly
+        quant = 256 if max_len <= 1024 else 1024
+        steps = min(-(-int((n + m).max()) // quant) * quant, 2 * max_len)
+        steps = -(-steps // 256) * 256
+
+        # host->device bytes are the bottleneck on thin links: when the
+        # chunk's alphabet fits 15 symbols (ACGTN does), remap each byte
+        # to a 4-bit code (equality-preserving bijection; 0 is padding)
+        # and nibble-pack — halves the transfer, the kernels only ever
+        # compare characters for equality
+        hist = np.bincount(qcat, minlength=256)
+        hist += np.bincount(tcat, minlength=256)
+        alphabet = np.flatnonzero(hist[1:]) + 1  # O(N), no sort; 0 is pad
         nd, md = jnp.asarray(n), jnp.asarray(m)
+        if len(alphabet) <= 15:
+            lut = np.zeros(256, np.uint8)
+            lut[alphabet] = np.arange(1, len(alphabet) + 1, dtype=np.uint8)
+            q4 = lut[qcat]
+            t4 = lut[tcat]
+            q4 = q4[0::2] | (q4[1::2] << 4)
+            t4 = t4[0::2] | (t4[1::2] << 4)
+            qrp, tp = _build_rows_packed(jnp.asarray(q4), jnp.asarray(t4),
+                                         nd, md, max_len=max_len,
+                                         band=band)
+        else:
+            qrp, tp = _build_rows(jnp.asarray(qcat), jnp.asarray(tcat),
+                                  nd, md, max_len=max_len, band=band)
+        args = (qrp, tp, nd, md)
+        if self._use_pallas():
+            try:
+                out = self._dispatch(args, max_len, band, steps, True)
+                return chunk, pairs, n, m, out
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"Pallas aligner kernels failed at bucket "
+                    f"({max_len}, {band}), steps={steps}; falling back to "
+                    f"the XLA kernels for this run: {e!r}", RuntimeWarning)
+                self.stats["pallas_fallback"] = 1
+                self._pallas_disabled = True
+        out = self._dispatch(args, max_len, band, steps, False)
+        return chunk, pairs, n, m, out
+
+    def _dispatch(self, args, max_len, band, steps, use_pallas):
         if self.mesh is not None:
             from ..parallel import sharded_align
-            out = sharded_align(self.mesh, jnp.asarray(qrp), jnp.asarray(tp),
-                                nd, md, max_len=max_len, band=band)
-        else:
-            out = align_chain(jnp.asarray(qrp), jnp.asarray(tp), nd, md,
-                              max_len=max_len, band=band)
-        return chunk, pairs, n, m, out
+            return sharded_align(self.mesh, *args, max_len=max_len,
+                                 band=band, steps=steps,
+                                 use_pallas=use_pallas)
+        return align_chain(*args, max_len=max_len, band=band, steps=steps,
+                           use_pallas=use_pallas)
 
     def _finish_chunk(self, launched, band, cigars, reject):
         chunk, pairs, n, m, out = launched
@@ -427,15 +561,16 @@ class TpuAligner:
 
         for k, idx in enumerate(chunk):
             diff = abs(int(n[k]) - int(m[k]))
-            # the path (n + m - #matches steps) ends at the first "done"
-            # code; a band escape stalls the walk, leaving (fi, fj) != 0.
-            stop = np.flatnonzero(ops[k] >= 3)
-            path_len = int(stop[0]) if len(stop) else 0
-            clean = (path_len > 0 and int(fi[k]) == 0 and int(fj[k]) == 0)
+            # real path codes are < 3 (a band escape stalls the walk,
+            # leaving (fi, fj) != 0); inactive-gap codes interleave on the
+            # Pallas walk and only trail on the XLA walk — filtering
+            # handles both
+            path = ops[k][ops[k] < 3]
+            clean = (len(path) > 0 and int(fi[k]) == 0 and int(fj[k]) == 0)
             # optimality certificate: an optimal path's diagonal wander is
             # bounded by its edit count; require it inside the half band.
             if int(score[k]) <= band // 2 - diff - 2 and clean:
-                cigars[idx] = _ops_to_cigar(ops[k], path_len)
+                cigars[idx] = _ops_to_cigar(path)
                 self.stats["device"] += 1
             else:
                 reject.append(idx)

@@ -57,6 +57,20 @@ import jax.experimental.pallas.tpu as pltpu
 _BIG = 1 << 28
 # extra tail lanes so aligned-window loads never run off the char arrays
 _LOAD_PAD = 256
+# VMEM budget for the walk kernels' double-buffered chunk window — long
+# aligner buckets shrink the pair-block (P) instead of overflowing VMEM
+# (the fwd kernel streams its direction rows to HBM by DMA, so it has no
+# comparable per-block buffer)
+_WALK_BUF_BYTES = 4 * 1024 * 1024
+
+
+def _cap_block(B: int, per_pair_bytes: int, budget: int) -> int:
+    # Mosaic block sublane counts must be multiples of 8 (or the whole
+    # array), so P never drops below 8
+    P = min(32, B)
+    while P > 8 and P * per_pair_bytes > budget:
+        P //= 2
+    return P
 
 
 def _rup(x: int, k: int) -> int:
@@ -76,23 +90,37 @@ def _load_window(ref, off, width: int, U: int):
     return pltpu.roll(win, shift=(W2 - r) % W2, axis=1)[:, :U]
 
 
-def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref, *,
-                max_len: int, band: int, P: int, width: int, steps: int):
+def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
+                stage, dsems, *, max_len: int, band: int, P: int,
+                width: int, steps: int, PER: int):
     W = band
     c = W // 2
     L = max_len
     U = W // 2
     RB = U // 4
     S = steps
-    # flush F wavefront rows per store so offsets stay 128-lane aligned
-    # (F*RB = lcm(RB, 128); e.g. RB=48 -> 8 rows / 384 lanes per flush)
+    # flush F wavefront rows per 128-aligned stage write (F*RB =
+    # lcm(RB, 128)); every PER stage writes, DMA the staged rows to HBM —
+    # the direction matrix streams out instead of occupying a VMEM output
+    # block, so arbitrarily long buckets fit
     FL = RB
     while FL % 128:
         FL += RB
     F = FL // RB
+    FPL = FL * PER
+    blk = pl.program_id(0)
     nn = n_ref[:, :]  # (P, 1) i32
     mm = m_ref[:, :]
     us = lax.broadcasted_iota(jnp.int32, (P, U), 1)
+
+    def stage_dma(slot, fidx):
+        # DMA stage slot -> dirs rows ending at flush index fidx
+        base = (fidx + 1) * FL - FPL
+        return pltpu.make_async_copy(
+            stage.at[slot],
+            dirs_ref.at[pl.ds(blk * P, P),
+                        pl.ds(pl.multiple_of(base, 128), FPL)],
+            dsems.at[slot])
 
     p0 = c & 1
     u0 = (c - p0) // 2
@@ -145,20 +173,46 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref, *,
 
         packed = (d[:, :RB] | (d[:, RB:2 * RB] << 2)
                   | (d[:, 2 * RB:3 * RB] << 4) | (d[:, 3 * RB:] << 6))
-        # rolling flush buffer: row a lands in the last RB lanes; every F
-        # wavefronts the buffer holds rows a-F+1..a and flushes 128-aligned
-        dbuf = pltpu.roll(dbuf, shift=FL - RB, axis=1)
-        dbuf = jnp.concatenate([dbuf[:, :FL - RB], packed], axis=1)
+        if FL == RB:
+            # rows are already 128-aligned (F == 1): no accumulation
+            dbuf = packed
+        else:
+            # rolling flush buffer: row a lands in the last RB lanes; every
+            # F wavefronts it holds rows a-F+1..a and moves to the stage
+            dbuf = pltpu.roll(dbuf, shift=FL - RB, axis=1)
+            dbuf = jnp.concatenate([dbuf[:, :FL - RB], packed], axis=1)
 
         @pl.when(a % F == 0)
         def _():
-            off = pl.multiple_of((a - F) * RB, 128)
-            dirs_ref[:, pl.ds(off, FL)] = dbuf.astype(jnp.uint8)
+            fidx = a // F - 1            # 0-based flush index
+            slot = (fidx // PER) % 2
+
+            # reusing a slot: its previous DMA must have drained
+            @pl.when((fidx % PER == 0) & (fidx >= 2 * PER))
+            def _():
+                stage_dma(slot, fidx - PER).wait()
+
+            stage[slot, :, pl.ds(pl.multiple_of((fidx % PER) * FL, 128),
+                                 FL)] = dbuf.astype(jnp.uint8)
+
+            @pl.when(fidx % PER == PER - 1)
+            def _():
+                stage_dma(slot, fidx).start()
 
         return v, v1, score, dbuf
 
     _, _, score, _ = lax.fori_loop(1, S + 1, step, (v0, vm1, score0, dbuf0))
     score_ref[:, :] = score
+
+    # drain outstanding DMAs (one or two slots in flight at the end)
+    NF = S // F
+    last = NF - 1
+
+    @pl.when(NF >= 2 * PER)
+    def _():
+        stage_dma(((last // PER) - 1) % 2, last - PER).wait()
+
+    stage_dma((last // PER) % 2, last).wait()
 
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
@@ -175,14 +229,19 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     FL = RB
     while FL % 128:
         FL += RB
-    if S % (FL // RB):
+    F = FL // RB
+    if S % F:
         raise ValueError(
-            f"steps={S} must divide the dirs flush period {FL // RB} "
+            f"steps={S} must divide the dirs flush period {F} "
             f"(band={band}); round steps up to a multiple of 256")
+    # stage ~2-4 KB per DMA, PER a power-of-two divisor of the flush count
+    PER = 1
+    while (PER * 2 * FL <= 4096 and (S // F) % (PER * 2) == 0):
+        PER *= 2
     qrp = jnp.pad(qrp, ((0, 0), (0, _LOAD_PAD)))
     tp = jnp.pad(tp, ((0, 0), (0, _LOAD_PAD)))
     kernel = functools.partial(_fwd_kernel, max_len=max_len, band=band,
-                               P=P, width=width, steps=S)
+                               P=P, width=width, steps=S, PER=PER)
     dirs, score = pl.pallas_call(
         kernel,
         grid=(B // P,),
@@ -195,18 +254,20 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((P, S * RB), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, S * RB), jnp.uint8),
             jax.ShapeDtypeStruct((B, 1), jnp.int32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((2, P, FL * PER), jnp.uint8),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
     )(qrp, tp, n.reshape(B, 1).astype(jnp.int32),
       m.reshape(B, 1).astype(jnp.int32))
     return dirs.reshape(B, S, RB), score.reshape(B)
-
 
 
 def _chunk_dma_factory(dirs_ref, buf, sems, blk, *, P, C, RB, S):
@@ -318,8 +379,8 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
     consumers mask on ``op < 3``.
     """
     B, S, RB = dirs.shape
-    P = min(32, B)
     C = min(128, S)
+    P = _cap_block(B, 2 * (C * RB + _rup(128 + RB, 128)), _WALK_BUF_BYTES)
     if S % C:
         raise ValueError(
             f"steps={S} must be a multiple of the walk chunk ({C}); "
@@ -329,7 +390,7 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
         kernel,
         grid=(B // P,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
@@ -544,8 +605,8 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
     the XLA prefix-sum vote prep on the consensus path."""
     B, S, RB = dirs.shape
     Lq = qcodes.shape[1]
-    P = min(32, B)
     C = min(128, S)
+    P = _cap_block(B, 2 * (C * RB + _rup(128 + RB, 128)), _WALK_BUF_BYTES)
     if S % C:
         raise ValueError(
             f"steps={S} must be a multiple of the walk chunk ({C}); "
@@ -556,7 +617,7 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
         kernel,
         grid=(B // P,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),

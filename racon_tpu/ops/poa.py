@@ -217,7 +217,7 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
 @functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
                                              "Lb", "K", "steps",
                                              "use_pallas"))
-def refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
+def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
                  bcodes, bweights, blen, covs, ever, frozen, dropped,
                  ins_theta, del_beta, *, n_windows: int, max_len: int,
                  band: int, Lb: int, K: int, steps: int = 0,
@@ -244,7 +244,17 @@ def refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
     Lq = max_len
     c = band // 2
     width = c + Lq + band
+    B = qcodes.shape[0]
     m = ed - bg + 1
+
+    # ---- reversed query rows derived on device (the host sends only the
+    # forward codes once; the reversed NW layout is a flip + mask)
+    core = jnp.where((Lq - 1 - jnp.arange(Lq, dtype=jnp.int32))[None, :]
+                     < n[:, None],
+                     jnp.flip(qcodes, axis=1), jnp.uint8(Q_PAD))
+    qrp = jnp.concatenate(
+        [jnp.full((B, c), Q_PAD, jnp.uint8), core,
+         jnp.full((B, band), Q_PAD, jnp.uint8)], axis=1)
 
     # ---- target rows gathered from the backbone state (codes, pad T_PAD)
     cols = jnp.arange(width, dtype=jnp.int32)[None, :] - c
@@ -476,11 +486,6 @@ class TpuPoaConsensus:
         ``items`` is a list of ``(result_index, _Work)``; pair rows beyond
         the shard's real pairs vote into the sink window ``nWp - 1``.
         """
-        band = self.band
-        c = band // 2
-        width = c + Lq + band
-
-        qrp = np.full((B, width), Q_PAD, np.uint8)
         n = np.ones(B, np.int32)
         qcodes = np.zeros((B, Lq), np.uint8)
         qweights = np.zeros((B, Lq), np.uint8)
@@ -513,14 +518,7 @@ class TpuPoaConsensus:
             pos = np.arange(Lq)[None, :]
             valid = pos < lens[:, None]
             src = starts[:, None] + np.minimum(pos, lens[:, None] - 1)
-            codes = np.where(valid, codes_cat[src], 0).astype(np.uint8)
-            qcodes[:k] = codes
-            # reversed layout: row ends at column c + Lq, so column c + j
-            # holds seq[Lq - 1 - j] when in range
-            rev_src = starts[:, None] + np.minimum(pos[:, ::-1],
-                                                   lens[:, None] - 1)
-            qrp[:k, c:c + Lq] = np.where(
-                valid[:, ::-1], codes_cat[rev_src], Q_PAD).astype(np.uint8)
+            qcodes[:k] = np.where(valid, codes_cat[src], 0).astype(np.uint8)
 
             qual_cat = np.frombuffer(
                 b"".join((t[2] if t[2] is not None else b"\x22" * len(t[1]))
@@ -543,7 +541,7 @@ class TpuPoaConsensus:
                     np.frombuffer(w.bqual, np.uint8).astype(np.float32) - 33.0
             blen[wi] = len(bb)
 
-        return (qrp, n, qcodes, qweights, win_of, real, bg, ed), \
+        return (n, qcodes, qweights, win_of, real, bg, ed), \
                (bcodes, bweights, blen)
 
     def _launch_group(self, live, Lq, Lb):
@@ -569,11 +567,11 @@ class TpuPoaConsensus:
 
         packs = [self._pack_shard(sh, Lq, B, nWp, Lb) for sh in shards]
         pair_np = [np.concatenate([p[0][a] for p in packs])
-                   for a in range(8)]
+                   for a in range(7)]
         win_np = [np.concatenate([p[1][a] for p in packs])
                   for a in range(3)]
-        static = tuple(jnp.asarray(a) for a in pair_np[:6])   # qrp..real
-        bg, ed = (jnp.asarray(pair_np[6]), jnp.asarray(pair_np[7]))
+        static = tuple(jnp.asarray(a) for a in pair_np[:5])   # n..real
+        bg, ed = (jnp.asarray(pair_np[5]), jnp.asarray(pair_np[6]))
         bcodes, bweights, blen = (jnp.asarray(a) for a in win_np)
         covs = jnp.zeros((nd * nWp, Lb), jnp.int32)
         ever = jnp.zeros(nd * nWp, bool)

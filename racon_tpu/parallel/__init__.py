@@ -70,11 +70,13 @@ def partition_balanced(costs: Sequence[int], n_bins: int) -> List[List[int]]:
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_align_fn(mesh: Mesh, max_len: int, band: int):
+def _sharded_align_fn(mesh: Mesh, max_len: int, band: int, steps: int,
+                      use_pallas: bool):
     from ..ops.nw import align_chain
 
     def local(qrp, tp, n, m):
-        return align_chain(qrp, tp, n, m, max_len=max_len, band=band)
+        return align_chain(qrp, tp, n, m, max_len=max_len, band=band,
+                           steps=steps, use_pallas=use_pallas)
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(local, mesh=mesh,
@@ -83,14 +85,16 @@ def _sharded_align_fn(mesh: Mesh, max_len: int, band: int):
                                  check_vma=False))
 
 
-def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int):
+def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int,
+                  steps: int = 0, use_pallas: bool = False):
     """NW + traceback with the batch dimension split over ``mesh``.
 
     Batch size must be a multiple of the mesh size (callers pad).
     Returns ``(ops_packed, score, fi, fj)`` exactly like the single-device
     ``_traceback_kernel``.
     """
-    return _sharded_align_fn(mesh, max_len, band)(qrp, tp, n, m)
+    return _sharded_align_fn(mesh, max_len, band, steps,
+                             use_pallas)(qrp, tp, n, m)
 
 
 @functools.lru_cache(maxsize=None)
@@ -99,10 +103,10 @@ def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
                        use_pallas: bool):
     from ..ops.poa import refine_round
 
-    def local(qrp, n, qcodes, qweights, win_of, real, bg, ed,
+    def local(n, qcodes, qweights, win_of, real, bg, ed,
               bcodes, bweights, blen, covs, ever, frozen, dropped,
               ins_theta, del_beta):
-        return refine_round(qrp, n, qcodes, qweights, win_of, real, bg, ed,
+        return refine_round(n, qcodes, qweights, win_of, real, bg, ed,
                             bcodes, bweights, blen, covs, ever, frozen,
                             dropped, ins_theta, del_beta,
                             n_windows=n_windows_local, max_len=max_len,
@@ -111,7 +115,7 @@ def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(
-        local, mesh=mesh, in_specs=(spec,) * 15 + (P(), P()),
+        local, mesh=mesh, in_specs=(spec,) * 14 + (P(), P()),
         out_specs=(spec,) * 9, check_vma=False))
 
 
@@ -121,7 +125,7 @@ def sharded_refine_round(mesh: Mesh, static, state, ins_theta, del_beta, *,
                          use_pallas: bool = False):
     """One device-resident refinement round over a co-sharded batch.
 
-    ``static`` = (qrp, n, qcodes, qweights, win_of, real) with leading dim
+    ``static`` = (n, qcodes, qweights, win_of, real) with leading dim
     ``n_shards * B_local``; ``win_of`` holds **shard-local** window
     ordinals.  ``state`` = (bg, ed, bcodes, bweights, blen, covs, ever,
     frozen, dropped) — pair-major arrays share the pair stacking, window

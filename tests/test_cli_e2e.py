@@ -50,3 +50,21 @@ def test_cli_progress_bars(cli_run):
 
 def test_cli_total_line(cli_run):
     assert "[racon_tpu::Polisher::] total =" in cli_run.stderr.decode()
+
+
+def test_cli_tpualigner_byte_exact(data_dir):
+    """Real-data golden through the device aligner path: the PAF input
+    carries no CIGARs, so ``--tpualigner-batches`` routes every breaking-
+    point alignment through the batched device aligner (XLA kernels on the
+    CPU test mesh; the Pallas kernels are bit-identical by probe) — stdout
+    must match the recorded CPU-path golden byte for byte."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "racon_tpu", "-t", "8",
+         "--tpualigner-batches", "1",
+         str(data_dir / "sample_reads.fastq.gz"),
+         str(data_dir / "sample_overlaps.paf.gz"),
+         str(data_dir / "sample_layout.fasta.gz")],
+        capture_output=True, timeout=600,
+        cwd=str(pathlib.Path(__file__).parent.parent))
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert proc.stdout == GOLDEN.read_bytes()
