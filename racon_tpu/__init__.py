@@ -12,8 +12,10 @@ built TPU-first:
 - ``racon_tpu.models``   — CPU reference algorithms: pairwise NW alignment and
   partial-order-alignment consensus with spoa-faithful semantics (reference:
   vendored ``edlib`` / ``spoa``).
-- ``racon_tpu.ops``      — JAX/XLA batched kernels: wavefront NW with
-  traceback and batched POA over fixed-shape window batches (reference:
+- ``racon_tpu.ops``      — the TPU compute path: Pallas (Mosaic) banded
+  wavefront-NW kernels with VMEM-resident wavefronts and a fused walk+vote
+  kernel (XLA fallbacks for non-TPU hosts), plus the device-resident POA
+  refinement engine over fixed-shape window batches (reference:
   ``cudaaligner`` / ``cudapoa`` SDK usage in ``src/cuda/``).
 - ``racon_tpu.parallel`` — device-mesh dispatch (`shard_map` over windows =
   reference's multi-GPU batch binning, ``src/cuda/cudapolisher.cpp:72-83``).

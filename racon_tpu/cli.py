@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use banding approximation for alignment on the TPU")
     p.add_argument("--tpualigner-batches", type=int, default=0,
                    help="number of batches for TPU accelerated alignment")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="write a jax.profiler trace of the polishing run "
+                        "to DIR (view with TensorBoard / xprof; the TPU "
+                        "analog of the reference's nvprof hooks)")
     return p
 
 
@@ -112,8 +116,15 @@ def main(argv=None) -> int:
         return 1
 
     try:
-        polisher.initialize()
-        polished = polisher.polish(not args.include_unpolished)
+        import contextlib
+        if args.profile:
+            import jax
+            trace = jax.profiler.trace(args.profile)
+        else:
+            trace = contextlib.nullcontext()
+        with trace:
+            polisher.initialize()
+            polished = polisher.polish(not args.include_unpolished)
     except (ValueError, RuntimeError, OSError) as e:
         print(f"[racon::] error: {e}", file=sys.stderr)
         return 1

@@ -110,7 +110,24 @@ def CpuPoaConsensus(match: int, mismatch: int, gap: int,
     return PythonPoaConsensus(match, mismatch, gap, num_threads)
 
 
-def make_aligner(backend: str, num_threads: int, num_batches: int = 1):
+def _auto_mesh(mesh):
+    """Resolve the device mesh for an accelerated backend: an explicit
+    mesh wins; otherwise every visible device is engaged when there is
+    more than one — the reference's `-c N` uses every visible GPU
+    (``src/cuda/cudapolisher.cpp:46,72-83``), and the TPU analog is a 1-D
+    ``shard_map`` mesh over ``jax.devices()``."""
+    if mesh is not None:
+        return mesh
+    import jax
+
+    from ..parallel import get_mesh
+    if len(jax.devices()) > 1:
+        return get_mesh()
+    return None
+
+
+def make_aligner(backend: str, num_threads: int, num_batches: int = 1,
+                 mesh=None):
     if backend == "python":
         return PythonAligner()
     if backend in ("native", "cpu"):
@@ -122,7 +139,7 @@ def make_aligner(backend: str, num_threads: int, num_batches: int = 1):
             raise ValueError(f"TPU aligner backend unavailable: {e}")
         return TpuAligner(fallback=NativeAligner(num_threads)
                           if native.available() else PythonAligner(),
-                          num_batches=num_batches)
+                          num_batches=num_batches, mesh=_auto_mesh(mesh))
     if backend == "auto":
         if native.available():
             return NativeAligner(num_threads)
@@ -132,7 +149,7 @@ def make_aligner(backend: str, num_threads: int, num_batches: int = 1):
 
 def make_consensus(backend: str, match: int, mismatch: int, gap: int,
                    num_threads: int = 1, num_batches: int = 1,
-                   banded: bool = False):
+                   banded: bool = False, mesh=None):
     if backend == "python":
         return PythonPoaConsensus(match, mismatch, gap, num_threads)
     if backend in ("native", "cpu"):
@@ -150,5 +167,6 @@ def make_consensus(backend: str, match: int, mismatch: int, gap: int,
                                fallback=CpuPoaConsensus(match, mismatch, gap,
                                                         num_threads),
                                band=BAND // 2 if banded else BAND,
-                               num_batches=num_batches)
+                               num_batches=num_batches,
+                               mesh=_auto_mesh(mesh))
     raise ValueError(f"unknown consensus backend {backend!r}")

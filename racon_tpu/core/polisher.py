@@ -13,6 +13,15 @@ Behavioural spec from the reference's ``src/polisher.cpp``:
   length, mean PHRED quality >= threshold);
 - ``polish()`` (``polisher.cpp:485-547``): per-window consensus via the
   backend, stitch per target, emit ``LN:i/RC:i/XC:f`` tags.
+
+Memory contract (reference analog: 1 GiB parse chunks,
+``polisher.cpp:26,227-263``): the parsers stream records line-by-line
+(never the whole file), overlaps release their CIGAR the moment breaking
+points are derived (``overlap.py: find_breaking_points``) and their
+breaking points once window layers are assigned; the device aligner sees
+the overlap stream in bounded 64k-pair slices, so transient span copies
+stay O(slice). Like the reference, the full sequence set stays resident
+(windows hold views into it); the wrapper's ``--split`` bounds that too.
 """
 
 from __future__ import annotations

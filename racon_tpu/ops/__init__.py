@@ -1,10 +1,13 @@
-"""JAX/XLA batched kernels — the TPU compute path.
+"""Batched device kernels — the TPU compute path.
 
-- ``racon_tpu.ops.nw``  — batched banded NW direction-matrix kernel + host
-  traceback (role of the reference's cudaaligner batches,
-  ``src/cuda/cudaaligner.cpp``).
-- ``racon_tpu.ops.poa`` — batched POA consensus kernel (role of cudapoa,
-  ``src/cuda/cudabatch.cpp``).
+- ``racon_tpu.ops.pallas_nw`` — Pallas (Mosaic) kernels: banded wavefront
+  NW forward with VMEM-resident wavefronts + DMA-streamed direction rows,
+  the wavefront-synchronized walk, and the fused walk+vote emitter.
+- ``racon_tpu.ops.nw``  — batched banded NW + on-device traceback with
+  bucketing/escalation and the XLA fallback kernels (role of the
+  reference's cudaaligner batches, ``src/cuda/cudaaligner.cpp``).
+- ``racon_tpu.ops.poa`` — device-resident batched POA consensus refinement
+  (role of cudapoa, ``src/cuda/cudabatch.cpp``).
 """
 
 import os as _os

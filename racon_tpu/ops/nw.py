@@ -248,30 +248,36 @@ def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0,
     return _traceback_kernel(packed, score, n, m, max_len=max_len, band=band)
 
 
-@functools.partial(jax.jit, static_argnames=("max_len", "band"))
-def _build_rows(qcat, tcat, n, m, *, max_len: int, band: int):
-    """Build the banded NW row layout on device from dense byte blocks
-    (pair k's query/target at ``k * max_len``): qrp holds the reversed
-    query ending at column ``c + max_len``, tp the forward target at
-    offset ``c`` — exactly the layout the host used to pack."""
+def _row_layout(n, m, *, max_len: int, band: int):
+    """Shared offset/validity math for the banded NW row layout: qrp holds
+    the reversed query ending at column ``c + max_len``, tp the forward
+    target at offset ``c`` — exactly the layout the host used to pack."""
     B = n.shape[0]
     c = band // 2
     width = c + max_len + band
     pos = jnp.arange(width, dtype=jnp.int32)[None, :]
     row0 = (jnp.arange(B, dtype=jnp.int32) * max_len)[:, None]
-
-    qoff = c + max_len - 1 - pos  # reversed: column c+j holds q[n-1-j']...
-    qvalid = (qoff >= 0) & (qoff < n[:, None])
-    qsrc = row0 + jnp.clip(qoff, 0, max_len - 1)
-    qrp = jnp.where(qvalid, jnp.take(qcat, qsrc.reshape(-1)
-                                     ).reshape(B, width), jnp.uint8(0))
-
+    qoff = c + max_len - 1 - pos  # reversed: column c+j holds q[...-j]
     toff = pos - c
-    tvalid = (toff >= 0) & (toff < m[:, None])
-    tsrc = row0 + jnp.clip(toff, 0, max_len - 1)
-    tp = jnp.where(tvalid, jnp.take(tcat, tsrc.reshape(-1)
-                                    ).reshape(B, width), jnp.uint8(0))
-    return qrp, tp
+    return (row0, (qoff, (qoff >= 0) & (qoff < n[:, None])),
+            (toff, (toff >= 0) & (toff < m[:, None])))
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def _build_rows(qcat, tcat, n, m, *, max_len: int, band: int):
+    """Build the banded NW row layout on device from dense byte blocks
+    (pair k's query/target at ``k * max_len``)."""
+    B = n.shape[0]
+    row0, qlay, tlay = _row_layout(n, m, max_len=max_len, band=band)
+
+    def fill(cat, lay):
+        off, valid = lay
+        src = row0 + jnp.clip(off, 0, max_len - 1)
+        w = src.shape[1]
+        return jnp.where(valid, jnp.take(cat, src.reshape(-1)
+                                         ).reshape(B, w), jnp.uint8(0))
+
+    return fill(qcat, qlay), fill(tcat, tlay)
 
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band"))
@@ -280,22 +286,17 @@ def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
     byte; code 0 is padding). Unpacking is a shift/mask on the gathered
     byte, so the wide row arrays never cross the host link."""
     B = n.shape[0]
-    c = band // 2
-    width = c + max_len + band
-    pos = jnp.arange(width, dtype=jnp.int32)[None, :]
-    row0 = (jnp.arange(B, dtype=jnp.int32) * max_len)[:, None]
+    row0, qlay, tlay = _row_layout(n, m, max_len=max_len, band=band)
 
-    def unpack(cat4, off, valid):
+    def unpack(cat4, lay):
+        off, valid = lay
         src = row0 + jnp.clip(off, 0, max_len - 1)
-        byte = jnp.take(cat4, (src // 2).reshape(-1)).reshape(B, width)
+        w = src.shape[1]
+        byte = jnp.take(cat4, (src // 2).reshape(-1)).reshape(B, w)
         code = (byte >> ((src % 2) * 4).astype(jnp.uint8)) & 0xF
         return jnp.where(valid, code.astype(jnp.uint8), jnp.uint8(0))
 
-    qoff = c + max_len - 1 - pos
-    qrp = unpack(q4, qoff, (qoff >= 0) & (qoff < n[:, None]))
-    toff = pos - c
-    tp = unpack(t4, toff, (toff >= 0) & (toff < m[:, None]))
-    return qrp, tp
+    return unpack(q4, qlay), unpack(t4, tlay)
 
 
 def _ops_to_cigar(path: np.ndarray) -> str:
@@ -312,7 +313,10 @@ def _ops_to_cigar(path: np.ndarray) -> str:
     return "".join(f"{e - s}{sym[int(arr[s])]}" for s, e in zip(starts, ends))
 
 
-class TpuAligner:
+from .pallas_nw import PallasDispatchMixin
+
+
+class TpuAligner(PallasDispatchMixin):
     """Batched device aligner with on-device traceback and host fallback.
 
     ``mesh``: optional 1-D :class:`jax.sharding.Mesh`; when given, every
@@ -466,14 +470,6 @@ class TpuAligner:
             progress(len(pairs), len(pairs))
         return cigars
 
-    _pallas_disabled = False
-
-    def _use_pallas(self) -> bool:
-        if self._pallas_disabled:
-            return False
-        from .pallas_nw import pallas_ok
-        return pallas_ok()
-
     def _launch_chunk(self, pairs, chunk, max_len, band):
         """Pack a chunk and dispatch its kernels; returns the in-flight
         handle consumed by ``_finish_chunk``. Device work proceeds
@@ -527,18 +523,13 @@ class TpuAligner:
             qrp, tp = _build_rows(jnp.asarray(qcat), jnp.asarray(tcat),
                                   nd, md, max_len=max_len, band=band)
         args = (qrp, tp, nd, md)
-        if self._use_pallas():
+        shape_key = (max_len, band, steps, B)
+        if self._use_pallas(shape_key):
             try:
                 out = self._dispatch(args, max_len, band, steps, True)
                 return chunk, pairs, n, m, out
             except Exception as e:
-                import warnings
-                warnings.warn(
-                    f"Pallas aligner kernels failed at bucket "
-                    f"({max_len}, {band}), steps={steps}; falling back to "
-                    f"the XLA kernels for this run: {e!r}", RuntimeWarning)
-                self.stats["pallas_fallback"] = 1
-                self._pallas_disabled = True
+                self._note_pallas_failure(shape_key, e)
         out = self._dispatch(args, max_len, band, steps, False)
         return chunk, pairs, n, m, out
 

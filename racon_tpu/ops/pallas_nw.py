@@ -65,12 +65,21 @@ _WALK_BUF_BYTES = 4 * 1024 * 1024
 
 
 def _cap_block(B: int, per_pair_bytes: int, budget: int) -> int:
-    # Mosaic block sublane counts must be multiples of 8 (or the whole
-    # array), so P never drops below 8
+    # Mosaic block sublane counts below 8 fail to lower ("Sublane
+    # broadcast" errors at B < 4, tiling pessimization below 8), so P
+    # never drops below 8 — wrappers pad tiny batches up to 8 rows first
     P = min(32, B)
     while P > 8 and P * per_pair_bytes > budget:
         P //= 2
     return P
+
+
+def _pad_rows(arrs, B: int, fills):
+    """Pad each (B, ...) array to 8 rows (the minimum Mosaic-legal pair
+    block); padded rows get ``fill`` and callers slice outputs back."""
+    pad = 8 - B
+    return [jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1),
+                    constant_values=f) for a, f in zip(arrs, fills)]
 
 
 def _rup(x: int, k: int) -> int:
@@ -221,7 +230,10 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     """Drop-in Pallas replacement for ``_nw_wavefront_kernel``: same
     inputs, same packed direction matrix [B, steps, RB] and scores [B]
     (``steps`` defaults to the full ``2*max_len`` sweep)."""
-    B, width = qrp.shape
+    B0, width = qrp.shape
+    if B0 < 8:
+        qrp, tp, n, m = _pad_rows([qrp, tp, n, m], B0, [0, 0, 1, 1])
+    B = qrp.shape[0]
     U = band // 2
     RB = U // 4
     S = steps if steps else 2 * max_len
@@ -267,7 +279,7 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
         ],
     )(qrp, tp, n.reshape(B, 1).astype(jnp.int32),
       m.reshape(B, 1).astype(jnp.int32))
-    return dirs.reshape(B, S, RB), score.reshape(B)
+    return dirs.reshape(B, S, RB)[:B0], score.reshape(B)[:B0]
 
 
 def _chunk_dma_factory(dirs_ref, buf, sems, blk, *, P, C, RB, S):
@@ -378,6 +390,9 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
     placement (codes >= 3 interleave with the path after M steps); all
     consumers mask on ``op < 3``.
     """
+    B0 = dirs.shape[0]
+    if B0 < 8:
+        dirs, n, m = _pad_rows([dirs, n, m], B0, [0, 1, 1])
     B, S, RB = dirs.shape
     C = min(128, S)
     P = _cap_block(B, 2 * (C * RB + _rup(128 + RB, 128)), _WALK_BUF_BYTES)
@@ -413,6 +428,31 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
     )(dirs.reshape(B, S * RB), n.reshape(B, 1).astype(jnp.int32),
       m.reshape(B, 1).astype(jnp.int32))
     return ops, fi.reshape(B), fj.reshape(B)
+
+
+class PallasDispatchMixin:
+    """Shared try-Pallas-then-XLA dispatch with a per-shape disable memo:
+    one exotic-shape Mosaic failure must not downgrade the whole run to
+    the XLA kernels (the big well-tested shapes dominate wall-clock)."""
+
+    _pallas_failed_shapes = None
+
+    def _use_pallas(self, shape_key) -> bool:
+        if self._pallas_failed_shapes and \
+                shape_key in self._pallas_failed_shapes:
+            return False
+        return pallas_ok()
+
+    def _note_pallas_failure(self, shape_key, exc) -> None:
+        import warnings
+        warnings.warn(
+            f"Pallas kernels failed at shape {shape_key}; using the XLA "
+            f"kernels for this shape: {exc!r}", RuntimeWarning)
+        if self._pallas_failed_shapes is None:
+            self._pallas_failed_shapes = set()
+        self._pallas_failed_shapes.add(shape_key)
+        self.stats["pallas_fallback"] = \
+            self.stats.get("pallas_fallback", 0) + 1
 
 
 _PALLAS_OK = None
@@ -603,6 +643,10 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
     """Fused walk + vote emission. Returns (idx [B,S] i32 — vote address
     or the sink VOT, w [B,S] u8, fi, fj). Replaces ``pallas_walk_ops`` +
     the XLA prefix-sum vote prep on the consensus path."""
+    B0 = dirs.shape[0]
+    if B0 < 8:
+        dirs, n, m, bg, qcodes, qweights_u8 = _pad_rows(
+            [dirs, n, m, bg, qcodes, qweights_u8], B0, [0, 1, 1, 0, 0, 0])
     B, S, RB = dirs.shape
     Lq = qcodes.shape[1]
     C = min(128, S)
@@ -643,4 +687,4 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
     )(dirs.reshape(B, S * RB), n.reshape(B, 1).astype(jnp.int32),
       m.reshape(B, 1).astype(jnp.int32),
       bg.reshape(B, 1).astype(jnp.int32), qcodes, qweights_u8)
-    return idx, w, fi.reshape(B), fj.reshape(B)
+    return idx[:B0], w[:B0], fi.reshape(B)[:B0], fj.reshape(B)[:B0]

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .nw import _nw_wavefront_kernel, _walk_ops_kernel
+from .pallas_nw import PallasDispatchMixin
 from ..core.window import WindowType
 
 # Alignment band for layer-vs-backbone-span alignment (layers are ~window
@@ -366,7 +367,7 @@ class _Work:
         self.n_seqs = len(win.sequences)
 
 
-class TpuPoaConsensus:
+class TpuPoaConsensus(PallasDispatchMixin):
     """Batched device consensus with CPU fallback for rejects.
 
     ``rounds`` controls iterative refinement: round r re-aligns every layer
@@ -385,15 +386,29 @@ class TpuPoaConsensus:
                  max_depth: int = 200, band: int = BAND, rounds: int = 5,
                  mesh=None, ins_theta: float = 0.25, del_beta: float = 0.6,
                  num_batches: int = 1):
-        # match/mismatch/gap kept for interface parity; the pileup engine
-        # votes by base weight rather than alignment score.
         self.fallback = fallback
         self.max_depth = max_depth
         self.band = band
         self.rounds = rounds
         self.mesh = mesh
-        self.ins_theta = ins_theta
-        self.del_beta = del_beta
+        # The pileup engine votes by base quality rather than alignment
+        # score, so the reference's POA scores map onto the emission
+        # thresholds instead of the DP (cudapoa consumes them directly,
+        # ``src/cuda/cudabatch.cpp:54-62``): a stronger gap penalty makes
+        # indels proportionally harder to emit — identity at the default
+        # ``-g -4``, so the recorded goldens are untouched. ``-m/-x`` have
+        # no quality-weighted analog; flag the divergence rather than
+        # silently ignoring them.
+        scale = max(abs(gap), 1) / 4.0
+        self.ins_theta = min(ins_theta * scale, 0.95)
+        self.del_beta = del_beta * scale
+        if (match, mismatch) != (3, -5):
+            import warnings
+            warnings.warn(
+                f"device consensus weighs votes by base quality; "
+                f"-m {match} -x {mismatch} only affect the CPU fallback "
+                f"engine (the gap penalty -g {gap} scales the device "
+                f"indel-emission thresholds)", RuntimeWarning)
         # Batch count (reference -c N, cudapolisher.cpp:215-228): windows
         # are LPT-split into N groups, every group's whole refinement loop
         # is dispatched before the first result is fetched (JAX async
@@ -581,37 +596,23 @@ class TpuPoaConsensus:
         return {"shards": shards, "static": static, "state": state,
                 "nWp": nWp, "nd": nd}
 
-    _pallas_disabled = False
-
-    def _use_pallas(self) -> bool:
-        if self._pallas_disabled:
-            return False
-        from .pallas_nw import pallas_ok
-        return pallas_ok()
-
     def _round(self, launch, Lq, Lb, steps) -> None:
         """Dispatch one refinement round for a group (no host sync).
 
         The Pallas availability probe runs at one small shape, so a Mosaic
         compile failure at the production shape (e.g. an exotic band or a
         VMEM overflow) is still possible — it surfaces synchronously at
-        dispatch, and we fall back to the XLA kernels for the rest of the
-        run instead of aborting the polish (jit compilation is eager, so
+        dispatch, and we fall back to the XLA kernels for that shape
+        instead of aborting the polish (jit compilation is eager, so
         only compile errors are catchable here; numerics are covered by
         the probe's bit-exact comparison)."""
-        if self._use_pallas():
+        shape_key = (Lq, self.band, steps, Lb)
+        if self._use_pallas(shape_key):
             try:
                 self._dispatch_round(launch, Lq, Lb, steps, True)
                 return
             except Exception as e:
-                import warnings
-                warnings.warn(
-                    f"Pallas consensus kernels failed at the production "
-                    f"shape (Lq={Lq}, band={self.band}, steps={steps}); "
-                    f"falling back to the XLA kernels for this run: {e!r}",
-                    RuntimeWarning)
-                self.stats["pallas_fallback"] = 1
-                self._pallas_disabled = True
+                self._note_pallas_failure(shape_key, e)
         self._dispatch_round(launch, Lq, Lb, steps, False)
 
     def _dispatch_round(self, launch, Lq, Lb, steps, use_pallas) -> None:

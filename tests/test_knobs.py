@@ -66,3 +66,24 @@ def test_banded_consensus_still_polishes():
     flags = eng.run(wins, True)
     assert all(flags)
     assert all(len(w.consensus) > 0 for w in wins)
+
+
+def test_device_scores_map_to_emission_thresholds():
+    """-g scales the device indel-emission thresholds (identity at the
+    default -4, so goldens are untouched); -m/-x warn that they only
+    affect the CPU fallback (cudapoa consumes the scores directly,
+    cudabatch.cpp:54-62 — the pileup engine's analog is this mapping)."""
+    import warnings
+
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    default = TpuPoaConsensus(3, -5, -4)
+    assert default.ins_theta == 0.25 and default.del_beta == 0.6
+
+    strong_gap = TpuPoaConsensus(3, -5, -8)
+    assert strong_gap.ins_theta == 0.5 and strong_gap.del_beta == 1.2
+
+    with warnings.catch_warnings(record=True) as wlist:
+        warnings.simplefilter("always")
+        TpuPoaConsensus(5, -4, -4)
+    assert any("CPU fallback" in str(w.message) for w in wlist)
