@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Build the native host core (analog of ci/common/build.sh).
+set -e
+cd "$(dirname "$0")/../.."
+python - <<'PY'
+from racon_tpu import native
+assert native.available(), "native build failed"
+print("libracon_native: built and loadable")
+PY

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# CPU test run (analog of ci/cpu/*): full suite on the 8-virtual-device
+# mesh, then the CPU-path CLI golden byte-diff.
+set -e
+cd "$(dirname "$0")/../.."
+python -m pytest tests/ -x -q
+DATA=/root/reference/test/data
+python -m racon_tpu -t 8 \
+  "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \
+  "$DATA/sample_layout.fasta.gz" > /tmp/ci_cpu_out.fasta
+cmp /tmp/ci_cpu_out.fasta tests/data/golden_lambda_fastq_paf.fasta
+echo "cpu golden: OK"

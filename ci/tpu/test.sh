@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Accelerated end-to-end golden run (analog of ci/gpu/cuda_test.sh:29-42):
+# polish lambda-phage through the device aligner + device consensus and
+# byte-diff the FASTA against the recorded device golden. Bit-identical
+# on the CPU mesh (XLA kernels) and on real TPU (Pallas kernels).
+set -e
+cd "$(dirname "$0")/../.."
+DATA=/root/reference/test/data
+python -m racon_tpu -t 8 -c 1 --tpualigner-batches 1 \
+  "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \
+  "$DATA/sample_layout.fasta.gz" > /tmp/ci_tpu_out.fasta
+cmp /tmp/ci_tpu_out.fasta tests/data/golden_lambda_fastq_paf_device.fasta
+echo "device golden: OK"

@@ -44,11 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlaps")
     p.add_argument("--no-trimming", action="store_true",
                    help="disables consensus trimming at window ends")
-    p.add_argument("-m", "--match", type=int, default=3,
+    from .ops.poa import DEFAULT_GAP, DEFAULT_MATCH, DEFAULT_MISMATCH
+    p.add_argument("-m", "--match", type=int, default=DEFAULT_MATCH,
                    help="score for matching bases")
-    p.add_argument("-x", "--mismatch", type=int, default=-5,
+    p.add_argument("-x", "--mismatch", type=int, default=DEFAULT_MISMATCH,
                    help="score for mismatching bases")
-    p.add_argument("-g", "--gap", type=int, default=-4,
+    p.add_argument("-g", "--gap", type=int, default=DEFAULT_GAP,
                    help="gap penalty (must be negative)")
     p.add_argument("-t", "--threads", type=int, default=1,
                    help="number of threads")

@@ -122,7 +122,8 @@ def parse_paf(path: str) -> Iterator[OverlapRecord]:
 
 
 def parse_mhap(path: str) -> Iterator[OverlapRecord]:
-    """MHAP: aid bid jaccard shared arc astart aend alen brc bstart bend blen (space-sep, 1-based ids)."""
+    """MHAP: aid bid jaccard shared arc astart aend alen brc bstart bend
+    blen (space-separated, 1-based ids)."""
     with open_maybe_gzip(path) as f:
         for raw in f:
             line = raw.rstrip()

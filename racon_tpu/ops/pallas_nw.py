@@ -427,7 +427,7 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
         ],
     )(dirs.reshape(B, S * RB), n.reshape(B, 1).astype(jnp.int32),
       m.reshape(B, 1).astype(jnp.int32))
-    return ops, fi.reshape(B), fj.reshape(B)
+    return ops[:B0], fi.reshape(B)[:B0], fj.reshape(B)[:B0]
 
 
 class PallasDispatchMixin:
@@ -436,10 +436,15 @@ class PallasDispatchMixin:
     the XLA kernels (the big well-tested shapes dominate wall-clock)."""
 
     _pallas_failed_shapes = None
+    # after this many distinct shape failures the breakage is systemic
+    # (e.g. a libtpu upgrade): disable globally instead of paying one
+    # failed Mosaic compile + warning per remaining shape
+    _PALLAS_MAX_SHAPE_FAILURES = 3
 
     def _use_pallas(self, shape_key) -> bool:
-        if self._pallas_failed_shapes and \
-                shape_key in self._pallas_failed_shapes:
+        failed = self._pallas_failed_shapes
+        if failed and (shape_key in failed
+                       or len(failed) >= self._PALLAS_MAX_SHAPE_FAILURES):
             return False
         return pallas_ok()
 

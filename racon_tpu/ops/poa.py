@@ -77,6 +77,9 @@ A, C, G, T, N_CODE, DEL = 0, 1, 2, 3, 4, 5
 # Packing codes distinct from every base code, so query padding never
 # "matches" target padding in the NW kernel's character compare.
 Q_PAD, T_PAD = 6, 7
+# Reference default POA scores (src/main.cpp; shared with the CLI so the
+# device-engine divergence warning tracks the real defaults).
+DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP = 3, -5, -4
 
 _CODE_LUT = np.full(256, N_CODE, dtype=np.uint8)
 for i, b in enumerate(b"ACGT"):
@@ -399,10 +402,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # ``-g -4``, so the recorded goldens are untouched. ``-m/-x`` have
         # no quality-weighted analog; flag the divergence rather than
         # silently ignoring them.
-        scale = max(abs(gap), 1) / 4.0
+        scale = max(abs(gap), 1) / abs(DEFAULT_GAP)
         self.ins_theta = min(ins_theta * scale, 0.95)
         self.del_beta = del_beta * scale
-        if (match, mismatch) != (3, -5):
+        if (match, mismatch) != (DEFAULT_MATCH, DEFAULT_MISMATCH):
             import warnings
             warnings.warn(
                 f"device consensus weighs votes by base quality; "
