@@ -48,13 +48,16 @@ def bench_consensus(windows):
     tpu.run(windows, trim=True)
     cold = time.perf_counter() - t0
     log(f"cold: {cold:.2f}s, stats={tpu.stats}")
-    tpu.stats = {k: 0 for k in tpu.stats}  # report warm-run stats only
 
-    log("TPU consensus: warm run...")
-    t0 = time.perf_counter()
-    tpu.run(windows, trim=True)
-    warm = time.perf_counter() - t0
-    log(f"warm: {warm:.2f}s")
+    # best-of-2 warm runs: the host<->device tunnel is shared and jittery
+    # (~2x swings observed); min is the standard noise-free estimator
+    warm = float("inf")
+    for r in range(2):
+        tpu.stats = {k: 0 for k in tpu.stats}  # stats = one warm run
+        t0 = time.perf_counter()
+        tpu.run(windows, trim=True)
+        warm = min(warm, time.perf_counter() - t0)
+    log(f"warm (best of 2): {warm:.2f}s")
 
     log("CPU consensus baseline...")
     t0 = time.perf_counter()
@@ -77,12 +80,26 @@ def bench_aligner():
     rng = np.random.default_rng(11)
     bases = np.frombuffer(b"ACGT", dtype=np.uint8)
     pairs = []
-    for _ in range(2048):
-        ln = int(rng.integers(2000, 8000))
+    for k in range(2048):
+        # a 1-in-32 slice of short ~40%-divergence pairs exercises the
+        # band-escape -> escalation cascade the rejects contract exists
+        # for (band_escalated lands in the stats below) without routing
+        # work into the widest buckets
+        hot = k % 32 == 0
+        ln = int(rng.integers(500, 900)) if hot else int(
+            rng.integers(2000, 8000))
         t = bases[rng.integers(0, 4, ln)]
         q = t.copy()
         flips = rng.random(ln) < 0.15
         q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        if hot:
+            # structural rearrangement: moving the first ~ln/2 bases to
+            # the end forces an off-diagonal path wander ~ln/2 wide with
+            # a tiny length difference, deterministically escaping the
+            # initial bucket's band — the escalate (and for the longest
+            # pairs host-fallback) legs of the reject cascade run
+            cut = len(q) // 2
+            q = np.concatenate([q[cut:], q[:cut]])
         pairs.append((q.tobytes(), t.tobytes()))
 
     # pipeline depth 2 (the reference tunes --cudaaligner-batches the
@@ -93,12 +110,15 @@ def bench_aligner():
     aligner.align_batch(pairs)
     cold = time.perf_counter() - t0
     log(f"cold: {cold:.2f}s, stats={aligner.stats}")
-    log("TPU aligner: warm run...")
-    t0 = time.perf_counter()
-    cigars = aligner.align_batch(pairs)
-    warm = time.perf_counter() - t0
+    log("TPU aligner: warm runs...")
+    warm = float("inf")
+    for r in range(2):
+        aligner.stats = {k: 0 for k in aligner.stats}  # one warm run
+        t0 = time.perf_counter()
+        cigars = aligner.align_batch(pairs)
+        warm = min(warm, time.perf_counter() - t0)
     bases_aligned = sum(len(q) for q, _ in pairs)
-    log(f"warm: {warm:.2f}s ({len(pairs) / warm:.1f} pairs/s)")
+    log(f"warm (best of 2): {warm:.2f}s ({len(pairs) / warm:.1f} pairs/s)")
     assert all(cigars)
 
     log("host aligner (Myers bit-parallel, 8 threads) on the same pairs...")
@@ -127,6 +147,63 @@ def bench_aligner():
         "aligner_vs_host8": round(host_t / warm, 3),
         "aligner_host_agreement": round(agree, 4),
         "aligner_banded_gcups": round(gcups, 2),
+        "aligner_stats": dict(aligner.stats),
+    }
+
+
+def bench_scale():
+    """Optional scaling probe (set RACON_TPU_BENCH_SCALE=N for an N-Mbp
+    synthetic genome at ~30x): measures consensus throughput at
+    BASELINE.md-like sizes — bucket churn, recompile behavior and the
+    memory cap only show up past the 96-window λ set."""
+    import os
+
+    mbp = float(os.environ.get("RACON_TPU_BENCH_SCALE", "0") or 0)
+    if not mbp:
+        return {}
+    import numpy as np
+    from racon_tpu.core.window import Window, WindowType
+    from racon_tpu.core.backends import CpuPoaConsensus
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    rng = np.random.default_rng(17)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    n_windows = int(mbp * 1e6) // 500
+    windows = []
+    for wi in range(n_windows):
+        truth = bases[rng.integers(0, 4, 500)]
+        bb = truth.copy()
+        flips = rng.random(500) < 0.10
+        bb[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * 500)
+        for _ in range(30):
+            layer = truth.copy()
+            flips = rng.random(500) < 0.12
+            layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+            layer = np.delete(layer, rng.integers(0, len(layer), 12))
+            win.add_layer(layer.tobytes(), b"9" * len(layer), 0, 499)
+        windows.append(win)
+
+    tpu = TpuPoaConsensus(3, -5, -4,
+                          fallback=CpuPoaConsensus(3, -5, -4, 8),
+                          num_batches=2)
+    log(f"scale probe: {n_windows} windows ({mbp} Mbp at 30x), cold...")
+    t0 = time.perf_counter()
+    tpu.run(windows, trim=True)
+    cold = time.perf_counter() - t0
+    log(f"scale cold: {cold:.2f}s")
+    tpu.stats = {k: 0 for k in tpu.stats}  # report the warm run only
+    t0 = time.perf_counter()
+    tpu.run(windows, trim=True)
+    warm = time.perf_counter() - t0
+    log(f"scale warm: {warm:.2f}s ({n_windows / warm:.1f} windows/s, "
+        f"{mbp / warm:.3f} Mbp/s)")
+    return {
+        "scale_mbp": mbp,
+        "scale_windows": n_windows,
+        "scale_windows_per_sec": round(n_windows / warm, 2),
+        "scale_mbp_per_sec": round(mbp / warm, 4),
+        "scale_stats": dict(tpu.stats),
     }
 
 
@@ -141,6 +218,7 @@ def main():
 
     cold, warm, cpu_t, stats = bench_consensus(windows)
     aligner_metrics = bench_aligner()
+    scale_metrics = bench_scale()
 
     # consensus device-utilization estimate: DP cell-updates across the 5
     # refinement rounds vs the VPU's rough int32 peak (8x128 lanes x 2
@@ -174,6 +252,7 @@ def main():
         "consensus_stats": stats,
         "consensus_vpu_util_est": round(vpu_util, 4),
         **aligner_metrics,
+        **scale_metrics,
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(result), flush=True)

@@ -197,8 +197,21 @@ class Polisher:
         log.log("[racon_tpu::Polisher::initialize] loaded overlaps")
         log.log()
 
-        for i, seq in enumerate(self.sequences):
-            seq.transmute(has_name[i], has_data[i], has_reverse[i])
+        # transmute-parallelism (reference P3: one future per sequence,
+        # ``polisher.cpp:368-377``): revcomp materialization is a numpy
+        # LUT-take + flip (``sequence.py``), which releases the GIL on
+        # real read lengths, so a thread pool parallelizes it
+        if self.num_threads > 1 and len(self.sequences) > 64:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(self.num_threads) as pool:
+                list(pool.map(
+                    lambda iv: iv[1].transmute(has_name[iv[0]],
+                                               has_data[iv[0]],
+                                               has_reverse[iv[0]]),
+                    enumerate(self.sequences)))
+        else:
+            for i, seq in enumerate(self.sequences):
+                seq.transmute(has_name[i], has_data[i], has_reverse[i])
 
         self.find_overlap_breaking_points(overlaps)
         log.log()

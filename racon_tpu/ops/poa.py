@@ -71,6 +71,11 @@ BAND = 512
 K_INS = 4
 # Columns of backbone-growth headroom per refinement round loop.
 GROW = 256
+# Pairs per device group: larger window sets split into several groups
+# dispatched in flight (keeps per-launch arrays and the vote scatter at a
+# steady size instead of one monolithic batch; the analog of cudapoa's
+# fixed per-batch memory, cudapolisher.cpp:219-228).
+MAX_GROUP_PAIRS = 8192
 # Vote channels: A C G T N DEL (stride 8 for cheap addressing).
 CH = 8
 A, C, G, T, N_CODE, DEL = 0, 1, 2, 3, 4, 5
@@ -461,26 +466,38 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # granularity and statically require it
             steps = -(-min(-(-max_nm // 256) * 256, 2 * Lq) // 256) * 256
             from ..parallel import partition_balanced
-            if self.num_batches == 1:
+            total_pairs = sum(len(w.layers) for _, w in live)
+            n_groups = max(self.num_batches,
+                           -(-total_pairs // MAX_GROUP_PAIRS))
+            if n_groups == 1:
                 groups = [list(live)]
             else:
                 bins = partition_balanced([len(w.layers) for _, w in live],
-                                          self.num_batches)
+                                          n_groups)
                 groups = [[live[i] for i in b] for b in bins if b]
-            launches = [self._launch_group(g, Lq, Lb) for g in groups]
-            for rnd in range(self.rounds):
-                for la in launches:
+            # bounded pipeline: at most num_batches+1 groups live on
+            # device at once (launch group k+1, then fetch group
+            # k-num_batches), so peak HBM is per-group, like cudapoa's
+            # fixed per-batch memory (cudapolisher.cpp:219-228)
+            total_units = len(groups) * self.rounds + 1
+            self._last_total_units = total_units
+            done_units = 0
+            inflight = []
+            for g in groups:
+                la = self._launch_group(g, Lq, Lb)
+                for rnd in range(self.rounds):
                     self._round(la, Lq, Lb, steps)
-                if progress is not None:
-                    # bar units = dispatched refinement rounds (+1 for the
-                    # fetch/stitch/fallback tail): rounds are dispatched
-                    # asynchronously and only the final fetch blocks, so
-                    # ticks show work entering the device pipeline, not
-                    # round completion — syncing per round to tick on
-                    # completion would reintroduce the tunnel round-trips
-                    # this engine exists to avoid
-                    progress(rnd + 1, self.rounds + 1)
-            for la in launches:
+                    done_units += 1
+                    if progress is not None:
+                        # ticks show rounds entering the device pipeline
+                        # (dispatch is async; only fetches block — syncing
+                        # per round would reintroduce the tunnel
+                        # round-trips this engine exists to avoid)
+                        progress(done_units, total_units)
+                inflight.append(la)
+                if len(inflight) > self.num_batches:
+                    self._finish_group(inflight.pop(0), trim, results)
+            for la in inflight:
                 self._finish_group(la, trim, results)
 
         cpu_idx = [i for i, r in enumerate(results) if r is None]
@@ -493,7 +510,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
             for i, f in zip(cpu_idx, flags):
                 results[i] = f
         if progress is not None:
-            progress(self.rounds + 1, self.rounds + 1)
+            # close the bar with the same denominator the in-loop ticks
+            # used (falls back to a single unit when nothing was live)
+            total_units = getattr(self, "_last_total_units", 1)
+            progress(total_units, total_units)
         return [bool(r) for r in results]
 
     # -------------------------------------------------------------- device
