@@ -8,12 +8,16 @@ Design (TPU-first): instead of porting cudapoa's irregular
 one-block-per-group graph POA, consensus is computed as a
 **quality-weighted pileup** refined over several device-resident rounds:
 
-1. every layer is globally aligned to its backbone span with the wavefront
-   NW kernel from ``ops.nw`` (all windows' layers in one fixed-shape batch —
-   thousands of concurrent alignments, the shape TPUs like);
-2. the walked alignment ops are turned into weighted votes (A/C/G/T/N/
-   deletion per backbone column, plus K insertion slots per junction) with
-   vectorized prefix sums and one scatter-add (``_vote_from_ops``);
+1. every layer is globally aligned to its backbone span with the banded
+   wavefront NW forward kernel (Pallas with VMEM-resident wavefronts on
+   TPU, the XLA scan from ``ops.nw`` elsewhere — all windows' layers in
+   one fixed-shape batch, thousands of concurrent alignments);
+2. the walk emits weighted votes (A/C/G/T/N/deletion per backbone column,
+   plus K insertion slots per junction): on TPU the fused Pallas
+   walk+vote kernel (``pallas_walk_vote``) emits each step's vote address
+   and weight directly from registers; the XLA path reconstructs them
+   from op codes with vectorized prefix sums (``_vote_from_ops``); both
+   land on bit-identical matrices via one shared scatter-add;
 3. consensus = per-column argmax over weighted base votes, a column
    dropped when deletion weight exceeds ``del_beta`` x the summed base
    weights, and insertion slot ``s`` emitted when its summed weight

@@ -112,3 +112,50 @@ def test_fragment_correction_smoke(data_dir, tmp_path):
     assert all(b"r LN:i:" in s.name for s in out)  # kF tags
     corrected = [s for s in out if b"XC:f:0.000000" not in s.name]
     assert len(corrected) > 5
+
+
+@slow
+def test_fragment_correction_device_backend(data_dir, tmp_path):
+    """-f through the device consensus engine (-c analog) on a 25-read
+    subset: per-read windows run on the accelerated pileup engine with
+    CPU fallback for thin pileups; read count must match the CPU engine
+    exactly and total corrected bases stay close to the CPU engine
+    (5% band: shallow 25-read pileups amplify the engines' intrinsic
+    divergence — the full-set reference analog is cudapoa kF 1,655,505
+    vs spoa 1,658,216 = 0.17%). Default scores on both engines so the
+    device threshold mapping is at identity."""
+    import racon_tpu.io.parsers as parsers
+
+    reads = []
+    for rec in parsers.parse_fastq(str(data_dir / "sample_reads.fastq.gz")):
+        reads.append(rec)
+        if len(reads) >= 25:
+            break
+    names = {r.name.split()[0] for r in reads}
+    reads_path = tmp_path / "subset.fastq"
+    with open(reads_path, "wb") as f:
+        for r in reads:
+            f.write(b"@" + r.name + b"\n" + r.data + b"\n+\n" + r.quality
+                    + b"\n")
+    ovl_path = tmp_path / "subset.paf"
+    with gzip.open(data_dir / "sample_ava_overlaps.paf.gz", "rb") as f, \
+            open(ovl_path, "wb") as out:
+        for line in f:
+            cols = line.split(b"\t")
+            if cols[0] in names and cols[5] in names:
+                out.write(line)
+
+    def run(backend):
+        p = create_polisher(str(reads_path), str(ovl_path),
+                            str(reads_path), PolisherType.F,
+                            num_threads=4, consensus_backend=backend)
+        p.initialize()
+        return p, p.polish(True)
+
+    _, cpu_out = run("auto")
+    p_dev, dev_out = run("tpu")
+    assert p_dev.consensus.stats["device_windows"] > 0
+    assert len(dev_out) == len(cpu_out)
+    cpu_total = sum(len(s.data) for s in cpu_out)
+    dev_total = sum(len(s.data) for s in dev_out)
+    assert abs(dev_total - cpu_total) <= 0.05 * cpu_total
