@@ -39,9 +39,10 @@ engine, mirroring ``StatusType`` rejects (``src/cuda/cudabatch.cpp:135-156``).
 
 Emission thresholds (``ins_theta``/``del_beta``) and the refinement round
 count were calibrated against the CPU engine on λ-phage: the recorded
-device golden is 1384 vs CPU 1324 (+4.5%, PAF input, real TPU v5e),
-matching the reference's own accelerated-path divergence (cudapoa 1385 vs
-spoa 1312, +5.6%, ``test/racon_test.cpp:312``).
+device golden is 1351 vs CPU 1324 (+2.0%, PAF input — bit-identical on
+real TPU v5e and the XLA CPU mesh), well inside the reference's own
+accelerated-path divergence (cudapoa 1385 vs spoa 1312, +5.6%,
+``test/racon_test.cpp:312``).
 
 Engine caps (documented, per ADVICE round 1): insertion runs longer than
 ``K_INS`` collapse extra bases into the last slot, and insertions before
@@ -395,8 +396,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
     """
 
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
-                 max_depth: int = 200, band: int = BAND, rounds: int = 5,
-                 mesh=None, ins_theta: float = 0.25, del_beta: float = 0.6,
+                 max_depth: int = 200, band: int = BAND, rounds: int = 6,
+                 mesh=None, ins_theta: float = 0.25, del_beta: float = 0.65,
                  num_batches: int = 1):
         self.fallback = fallback
         self.max_depth = max_depth

@@ -72,16 +72,15 @@ def test_fragment_correction_kf_mhap_qualities(data_dir):
     assert abs(total - 1658216) <= 0.001 * 1658216
 
 
-def test_fragment_correction_smoke(data_dir, tmp_path):
-    """Fast -f smoke: correct the first 25 reads against themselves using
-    only their ava overlaps; exercises the kF keep-all-overlaps filter,
-    dual-strand layers, and the 'r' output tag in every test run."""
+def _subset_inputs(data_dir, tmp_path, n_reads=25):
+    """First ``n_reads`` reads + their ava overlaps, written to tmp files
+    (shared by the -f smoke and device-backend tests)."""
     import racon_tpu.io.parsers as parsers
 
     reads = []
     for rec in parsers.parse_fastq(str(data_dir / "sample_reads.fastq.gz")):
         reads.append(rec)
-        if len(reads) >= 25:
+        if len(reads) >= n_reads:
             break
     names = {r.name.split()[0] for r in reads}
 
@@ -101,6 +100,14 @@ def test_fragment_correction_smoke(data_dir, tmp_path):
                 out.write(line)
                 kept += 1
     assert kept > 10
+    return reads_path, ovl_path, names
+
+
+def test_fragment_correction_smoke(data_dir, tmp_path):
+    """Fast -f smoke: correct the first 25 reads against themselves using
+    only their ava overlaps; exercises the kF keep-all-overlaps filter,
+    dual-strand layers, and the 'r' output tag in every test run."""
+    reads_path, ovl_path, names = _subset_inputs(data_dir, tmp_path)
 
     p = create_polisher(str(reads_path), str(ovl_path), str(reads_path),
                         PolisherType.F, window_length=500,
@@ -124,26 +131,7 @@ def test_fragment_correction_device_backend(data_dir, tmp_path):
     divergence — the full-set reference analog is cudapoa kF 1,655,505
     vs spoa 1,658,216 = 0.17%). Default scores on both engines so the
     device threshold mapping is at identity."""
-    import racon_tpu.io.parsers as parsers
-
-    reads = []
-    for rec in parsers.parse_fastq(str(data_dir / "sample_reads.fastq.gz")):
-        reads.append(rec)
-        if len(reads) >= 25:
-            break
-    names = {r.name.split()[0] for r in reads}
-    reads_path = tmp_path / "subset.fastq"
-    with open(reads_path, "wb") as f:
-        for r in reads:
-            f.write(b"@" + r.name + b"\n" + r.data + b"\n+\n" + r.quality
-                    + b"\n")
-    ovl_path = tmp_path / "subset.paf"
-    with gzip.open(data_dir / "sample_ava_overlaps.paf.gz", "rb") as f, \
-            open(ovl_path, "wb") as out:
-        for line in f:
-            cols = line.split(b"\t")
-            if cols[0] in names and cols[5] in names:
-                out.write(line)
+    reads_path, ovl_path, _ = _subset_inputs(data_dir, tmp_path)
 
     def run(backend):
         p = create_polisher(str(reads_path), str(ovl_path),

@@ -78,10 +78,10 @@ def test_device_scores_map_to_emission_thresholds():
     from racon_tpu.ops.poa import TpuPoaConsensus
 
     default = TpuPoaConsensus(3, -5, -4)
-    assert default.ins_theta == 0.25 and default.del_beta == 0.6
+    assert default.ins_theta == 0.25 and default.del_beta == 0.65
 
     strong_gap = TpuPoaConsensus(3, -5, -8)
-    assert strong_gap.ins_theta == 0.5 and strong_gap.del_beta == 1.2
+    assert strong_gap.ins_theta == 0.5 and strong_gap.del_beta == 1.3
 
     with warnings.catch_warnings(record=True) as wlist:
         warnings.simplefilter("always")

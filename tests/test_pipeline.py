@@ -78,7 +78,7 @@ def test_consensus_fasta_paf_golden(data_dir):
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
 def test_device_consensus_quality(data_dir):
     """Device (TpuPoaConsensus) pipeline quality: like the reference's CUDA
-    goldens, the accelerated engine records its own target — 1384 vs CPU
+    goldens, the accelerated engine records its own target — 1351 vs CPU
     1324 (reference: cudapoa 1385 vs spoa 1312,
     ``test/racon_test.cpp:312``). Vote weights are integral, so float
     scatter sums are exact and order-independent — the XLA kernels on
@@ -94,7 +94,7 @@ def test_device_consensus_quality(data_dir):
     # the quality must come from the device path, not CPU fallback
     assert engine.stats["device_windows"] > 90, engine.stats
     d = rc_distance_to_reference(data_dir, polished)
-    assert d == 1384  # device golden (real TPU == CPU-mesh XLA)
+    assert d == 1351  # device golden (real TPU == CPU-mesh XLA)
 
 
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
