@@ -48,10 +48,12 @@ BUCKETS: Tuple[Tuple[int, int], ...] = (
 TYPICAL_DIVERGENCE = 0.25
 # Upper bound on the packed direction-matrix bytes held across in-flight
 # device batches (v5e has 16 GiB HBM; the matrix never leaves the
-# device). Small caps fragment long-bucket batches into many chunks, and
-# each chunk pays a dispatch round-trip — 4 GiB keeps 2-8 kbp overlap
-# batches in a handful of chunks.
-MAX_DIRS_BYTES = 4 * 1024 * 1024 * 1024
+# device). Small caps fragment long-bucket batches into many chunks and
+# each chunk pays a dispatch round-trip; huge chunks coarsen the
+# pack/transfer/compute pipeline overlap — 2 GiB with steps-accurate
+# per-pair accounting keeps 2-8 kbp overlap batches in a handful of
+# chunks either way.
+MAX_DIRS_BYTES = 2 * 1024 * 1024 * 1024
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
 def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
@@ -299,6 +301,17 @@ def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
     return unpack(q4, qlay), unpack(t4, tlay)
 
 
+def _sweep_bound(max_nm: int, max_len: int) -> int:
+    """Anti-diagonal sweep bound for a bucket/chunk: the longest real pair
+    rounded coarsely (1024 for long buckets, so per-chunk shapes stay
+    compile-cache-friendly), capped at the full sweep, multiple of 256
+    (the Pallas kernels' chunk/flush granularity). Shared by the chunk
+    launcher and the memory-budget sizing so they account identically."""
+    quant = 256 if max_len <= 1024 else 1024
+    steps = min(-(-max_nm // quant) * quant, 2 * max_len)
+    return -(-steps // 256) * 256
+
+
 def _ops_to_cigar(path: np.ndarray) -> str:
     """Run-length encode a backward-order op path into a CIGAR string
     (callers pre-filter ``ops < 3`` — the Pallas walk interleaves
@@ -405,8 +418,16 @@ class TpuAligner(PallasDispatchMixin):
             for bi in sorted(by_bucket):
                 indices = by_bucket[bi]
                 max_len, band = self.buckets[bi]
+                # budget by the real sweep bound, not the worst case: the
+                # direction matrix is (B, steps, band/8) and steps tracks
+                # the longest pair in the bucket — budgeting 2*max_len
+                # halved the chunk size (and doubled the dispatch syncs)
+                # for typical pairs well under the bucket cap
+                max_nm = max(len(pairs[i][0]) + len(pairs[i][1])
+                             for i in indices)
+                steps_est = _sweep_bound(max_nm, max_len)
                 raw_cap = (self.max_dirs_bytes // self.num_batches
-                           ) // (max_len * (band // 4))
+                           ) // (steps_est * (band // 8))
                 # chunks pad to mesh_size * 2^k (see _pad_batch), so cap
                 # at the largest such size to keep the memory bound honest
                 batch_cap = mesh_size(self.mesh)
@@ -494,11 +515,7 @@ class TpuAligner(PallasDispatchMixin):
                 np.frombuffer(tb, dtype=np.uint8)
             n[k], m[k] = len(qb), len(tb)
 
-        # sweep bound: the longest real pair, rounded coarsely (1024 for
-        # long buckets) so the per-chunk shape stays compile-cache-friendly
-        quant = 256 if max_len <= 1024 else 1024
-        steps = min(-(-int((n + m).max()) // quant) * quant, 2 * max_len)
-        steps = -(-steps // 256) * 256
+        steps = _sweep_bound(int((n + m).max()), max_len)
 
         # host->device bytes are the bottleneck on thin links: when the
         # chunk's alphabet fits 15 symbols (ACGTN does), remap each byte
