@@ -21,10 +21,11 @@ import pytest
 GOLDEN = pathlib.Path(__file__).parent / "data" / "golden_lambda_fastq_paf.fasta"
 
 
-@pytest.fixture(scope="module")
-def cli_run(data_dir):
+def run_cli(data_dir, *extra_args):
+    """Canonical λ-phage CLI invocation (+ optional extra flags) — the
+    single definition every e2e test shares."""
     proc = subprocess.run(
-        [sys.executable, "-m", "racon_tpu", "-t", "8",
+        [sys.executable, "-m", "racon_tpu", "-t", "8", *extra_args,
          str(data_dir / "sample_reads.fastq.gz"),
          str(data_dir / "sample_overlaps.paf.gz"),
          str(data_dir / "sample_layout.fasta.gz")],
@@ -32,6 +33,11 @@ def cli_run(data_dir):
         cwd=str(pathlib.Path(__file__).parent.parent))
     assert proc.returncode == 0, proc.stderr.decode()[-2000:]
     return proc
+
+
+@pytest.fixture(scope="module")
+def cli_run(data_dir):
+    return run_cli(data_dir)
 
 
 def test_cli_stdout_byte_exact(cli_run):
@@ -58,13 +64,15 @@ def test_cli_tpualigner_byte_exact(data_dir):
     point alignment through the batched device aligner (XLA kernels on the
     CPU test mesh; the Pallas kernels are bit-identical by probe) — stdout
     must match the recorded CPU-path golden byte for byte."""
-    proc = subprocess.run(
-        [sys.executable, "-m", "racon_tpu", "-t", "8",
-         "--tpualigner-batches", "1",
-         str(data_dir / "sample_reads.fastq.gz"),
-         str(data_dir / "sample_overlaps.paf.gz"),
-         str(data_dir / "sample_layout.fasta.gz")],
-        capture_output=True, timeout=600,
-        cwd=str(pathlib.Path(__file__).parent.parent))
-    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    proc = run_cli(data_dir, "--tpualigner-batches", "1")
     assert proc.stdout == GOLDEN.read_bytes()
+
+
+def test_cli_profile_flag(data_dir, tmp_path):
+    """--profile wraps the run in a jax.profiler trace (the nvprof-hooks
+    analog): the run must still produce the golden bytes and leave a
+    trace directory behind."""
+    prof_dir = tmp_path / "trace"
+    proc = run_cli(data_dir, "--profile", str(prof_dir))
+    assert proc.stdout == GOLDEN.read_bytes()
+    assert prof_dir.exists() and any(prof_dir.iterdir())
