@@ -160,3 +160,23 @@ def test_multi_target_stitch(tmp_path):
                                           truths[ti].tobytes())
         d_polished = native.edit_distance(seq.data, truths[ti].tobytes())
         assert d_polished < d_backbone / 2, (ti, d_polished, d_backbone)
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_banded(data_dir):
+    """-b banded approximation through the device engine: half the
+    alignment band for speed at a quality cost, like banded cudapoa
+    (reference banded golden degrades to 4168 from 1385 full-band,
+    ``test/racon_test.cpp:400``). Recorded: 3182 (bit-reproducible
+    across XLA-on-CPU-mesh and Pallas-on-TPU, like the full-band
+    golden)."""
+    p = create_polisher(str(data_dir / "sample_reads.fastq.gz"),
+                        str(data_dir / "sample_overlaps.paf.gz"),
+                        str(data_dir / "sample_layout.fasta.gz"),
+                        num_threads=8, consensus_backend="tpu",
+                        banded=True)
+    p.initialize()
+    (polished,) = p.polish(True)
+    assert p.consensus.stats["device_windows"] > 90
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d == 3182  # banded device golden
