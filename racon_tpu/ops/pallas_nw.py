@@ -143,9 +143,10 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
     score0 = jnp.where(nn + mm == 0, 0, _BIG)
     dbuf0 = jnp.zeros((P, FL), jnp.int32) + zrow
 
-    def step(a, carry):
-        v1, v2, score, dbuf = carry
-        p = (a + c) & 1
+    def substep(a, p, v1, v2, score, dbuf, qchars, tchars):
+        """One wavefront with *statically known* parity ``p`` (the
+        two-step loop body alternates p=1 then p=0, so every branch on
+        parity folds at trace time)."""
         I0 = (a + c - p) // 2
         J0 = (a - c + p) // 2
         i_vec = I0 - us
@@ -154,16 +155,16 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
         # shifted views of wavefront a-1 (parity alternates):
         #   p == 0: D-source = v1[u-1], I-source = v1[u]
         #   p == 1: D-source = v1[u],   I-source = v1[u+1]
-        v1_left = jnp.where(us == 0, _BIG, pltpu.roll(v1, shift=1, axis=1))
-        v1_right = jnp.where(us == U - 1, _BIG,
-                             pltpu.roll(v1, shift=U - 1, axis=1))
-        d_src = jnp.where(p == 0, v1_left, v1)
-        i_src = jnp.where(p == 0, v1, v1_right)
+        if p == 0:
+            d_src = jnp.where(us == 0, _BIG,
+                              pltpu.roll(v1, shift=1, axis=1))
+            i_src = v1
+        else:
+            d_src = v1
+            i_src = jnp.where(us == U - 1, _BIG,
+                              pltpu.roll(v1, shift=U - 1, axis=1))
 
-        qchars = _load_window(qrp_ref, c + L - I0, width, U)
-        tchars = _load_window(tp_ref, c + J0 - 1, width, U)
         sub = jnp.where(qchars == tchars, 0, 1)
-
         cd = v2 + sub          # diagonal (i-1, j-1)
         ci = i_src + 1         # consume query (i-1, j)
         cdel = d_src + 1       # consume target (i, j-1)
@@ -210,7 +211,29 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
 
         return v, v1, score, dbuf
 
-    _, _, score, _ = lax.fori_loop(1, S + 1, step, (v0, vm1, score0, dbuf0))
+    # two wavefronts per iteration: with even c, parity is a & 1, so the
+    # body sees p statically — and the character windows only advance on
+    # one parity each (q on even a, t on odd a), halving the expensive
+    # aligned-load + dynamic-roll work to one q- and one t-load per pair
+    # of steps (odd a reuses the previous even step's query window; even
+    # a reuses the odd step's target window)
+    assert c % 2 == 0, "band/2 must be even for the two-step parity fold"
+    qch0 = _load_window(qrp_ref, c + L - c // 2, width, U)
+
+    def two_steps(k, carry):
+        v1, v2, score, dbuf, qch = carry
+        a1 = 2 * k + 1                   # p = 1
+        tch = _load_window(tp_ref, c + (a1 - c + 1) // 2 - 1, width, U)
+        v1, v2, score, dbuf = substep(a1, 1, v1, v2, score, dbuf,
+                                      qch, tch)
+        a2 = 2 * k + 2                   # p = 0
+        qch = _load_window(qrp_ref, c + L - (a2 + c) // 2, width, U)
+        v1, v2, score, dbuf = substep(a2, 0, v1, v2, score, dbuf,
+                                      qch, tch)
+        return v1, v2, score, dbuf, qch
+
+    _, _, score, _, _ = lax.fori_loop(
+        0, S // 2, two_steps, (v0, vm1, score0, dbuf0, qch0))
     score_ref[:, :] = score
 
     # drain outstanding DMAs (one or two slots in flight at the end)
@@ -242,10 +265,11 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     while FL % 128:
         FL += RB
     F = FL // RB
-    if S % F:
+    if S % F or S % 2:
         raise ValueError(
-            f"steps={S} must divide the dirs flush period {F} "
-            f"(band={band}); round steps up to a multiple of 256")
+            f"steps={S} must be even and divisible by the dirs flush "
+            f"period {F} (band={band}); round steps up to a multiple "
+            f"of 256")
     # stage ~2-4 KB per DMA, PER a power-of-two divisor of the flush count
     PER = 1
     while (PER * 2 * FL <= 4096 and (S // F) % (PER * 2) == 0):
