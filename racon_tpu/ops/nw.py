@@ -304,12 +304,13 @@ def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
 def _sweep_bound(max_nm: int, max_len: int) -> int:
     """Anti-diagonal sweep bound for a bucket/chunk: the longest real pair
     rounded coarsely (1024 for long buckets, so per-chunk shapes stay
-    compile-cache-friendly), capped at the full sweep, multiple of 256
-    (the Pallas kernels' chunk/flush granularity). Shared by the chunk
+    compile-cache-friendly), capped at the full sweep, multiple of 128
+    (the Pallas kernels' granularity: every band's flush period
+    F = FL/RB and the walk chunk C divide 128). Shared by the chunk
     launcher and the memory-budget sizing so they account identically."""
-    quant = 256 if max_len <= 1024 else 1024
+    quant = 128 if max_len <= 1024 else 1024
     steps = min(-(-max_nm // quant) * quant, 2 * max_len)
-    return -(-steps // 256) * 256
+    return -(-steps // 128) * 128
 
 
 def _ops_to_cigar(path: np.ndarray) -> str:

@@ -57,6 +57,12 @@ import jax.experimental.pallas.tpu as pltpu
 _BIG = 1 << 28
 # extra tail lanes so aligned-window loads never run off the char arrays
 _LOAD_PAD = 256
+# pair-block (sublane) caps: the TPU grid is sequential, so bigger blocks
+# amortize per-step loop/DMA overhead across more pairs; 64 measured best
+# on v5e for both kernels (32 leaves ~30% on the table, 128 regresses the
+# walk); module constants so the profiling harness can sweep them
+FWD_P_CAP = 64
+WALK_P_CAP = 64
 # VMEM budget for the walk kernels' double-buffered chunk window — long
 # aligner buckets shrink the pair-block (P) instead of overflowing VMEM
 # (the fwd kernel streams its direction rows to HBM by DMA, so it has no
@@ -67,8 +73,12 @@ _WALK_BUF_BYTES = 4 * 1024 * 1024
 def _cap_block(B: int, per_pair_bytes: int, budget: int) -> int:
     # Mosaic block sublane counts below 8 fail to lower ("Sublane
     # broadcast" errors at B < 4, tiling pessimization below 8), so P
-    # never drops below 8 — wrappers pad tiny batches up to 8 rows first
-    P = min(32, B)
+    # never drops below 8 — wrappers pad tiny batches up to 8 rows first.
+    # B is always a power of two >= 8 here (wrappers pad), so the halving
+    # loop keeps P a power-of-two divisor of B; assert rather than
+    # silently truncating grid rows if a future caller breaks that.
+    assert B >= 8 and (B & (B - 1)) == 0, f"batch {B} not a power of two"
+    P = min(WALK_P_CAP, B)
     while P > 8 and P * per_pair_bytes > budget:
         P //= 2
     return P
@@ -260,7 +270,7 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     U = band // 2
     RB = U // 4
     S = steps if steps else 2 * max_len
-    P = min(32, B)
+    P = min(FWD_P_CAP, B)
     FL = RB
     while FL % 128:
         FL += RB
@@ -269,7 +279,7 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
         raise ValueError(
             f"steps={S} must be even and divisible by the dirs flush "
             f"period {F} (band={band}); round steps up to a multiple "
-            f"of 256")
+            f"of 128")
     # stage ~2-4 KB per DMA, PER a power-of-two divisor of the flush count
     PER = 1
     while (PER * 2 * FL <= 4096 and (S // F) % (PER * 2) == 0):
@@ -423,7 +433,7 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
     if S % C:
         raise ValueError(
             f"steps={S} must be a multiple of the walk chunk ({C}); "
-            f"round steps up to a multiple of 256")
+            f"round steps up to a multiple of 128")
     kernel = functools.partial(_walk_kernel, band=band, P=P, C=C, steps=S)
     ops, fi, fj = pl.pallas_call(
         kernel,
@@ -683,7 +693,7 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
     if S % C:
         raise ValueError(
             f"steps={S} must be a multiple of the walk chunk ({C}); "
-            f"round steps up to a multiple of 256")
+            f"round steps up to a multiple of 128")
     kernel = functools.partial(_walk_vote_kernel, band=band, P=P, C=C,
                                steps=S, Lq=Lq, L=L, K=K, CH=CH, DEL=DEL)
     idx, w, fi, fj = pl.pallas_call(

@@ -217,12 +217,17 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
     base_total = col_votes[:, :, :N_CODE + 1].sum(-1)
     del_w = col_votes[:, :, DEL]
     winner = jnp.where(del_w > del_beta * base_total, DEL, base_winner)
-    coverage = jnp.take_along_axis(col_unw, winner[..., None], -1)[..., 0]
+    # winner-channel lookups as one-hot selects (take_along_axis lowers to
+    # a generic gather, which is slow on TPU)
+    ch_iota = jnp.arange(CH, dtype=winner.dtype)
+    coverage = jnp.sum(
+        jnp.where(winner[..., None] == ch_iota, col_unw, 0), axis=-1)
     col_total = col_votes.sum(-1)
 
     ins_winner = jnp.argmax(ins_votes[:, :, :, :N_CODE + 1], axis=-1)
     ins_total = ins_votes[:, :, :, :N_CODE + 1].sum(-1)
-    ins_cov = jnp.take_along_axis(ins_unw, ins_winner[..., None], -1)[..., 0]
+    ins_cov = jnp.sum(
+        jnp.where(ins_winner[..., None] == ch_iota, ins_unw, 0), axis=-1)
     ins_emit = ins_total > ins_theta * col_total[:, :, None]
 
     return winner, coverage, ins_winner, ins_emit, ins_cov
@@ -270,12 +275,17 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
         [jnp.full((B, c), Q_PAD, jnp.uint8), core,
          jnp.full((B, band), Q_PAD, jnp.uint8)], axis=1)
 
-    # ---- target rows gathered from the backbone state (codes, pad T_PAD)
+    # ---- target rows from the backbone state: one row gather, then a
+    # per-pair lane shift by ``bg`` via binary-decomposed rolls (wrapped
+    # lanes always fall outside [0, m) and are masked) — the elementwise
+    # rolls are ~8x cheaper than the generic 2-D gather they replace
     cols = jnp.arange(width, dtype=jnp.int32)[None, :] - c
-    src = bg[:, None] + cols
-    flat_src = win_of[:, None] * Lb + jnp.clip(src, 0, Lb - 1)
-    tval = jnp.take(bcodes.reshape(-1), flat_src)
-    tp = jnp.where((cols >= 0) & (cols < m[:, None]), tval, jnp.uint8(T_PAD))
+    bbrow = jnp.take(bcodes, win_of, axis=0)            # (B, Lb)
+    y = jnp.pad(bbrow, ((0, 0), (c, width - c - Lb)))
+    for k in range((Lb - 1).bit_length()):
+        y = jnp.where(((bg[:, None] >> k) & 1).astype(bool),
+                      jnp.roll(y, -(1 << k), axis=1), y)
+    tp = jnp.where((cols >= 0) & (cols < m[:, None]), y, jnp.uint8(T_PAD))
 
     if use_pallas:
         from .pallas_nw import pallas_nw_fwd, pallas_walk_vote
@@ -467,9 +477,9 @@ class TpuPoaConsensus(PallasDispatchMixin):
             max_nm = max(
                 len(s) + min((e - b + 1) + 64, Lb)
                 for _, w in live for s, _, b, e in w.layers)
-            # multiple of 256: the Pallas kernels chunk/flush at 128-lane
+            # multiple of 128: the Pallas kernels chunk/flush at 128-lane
             # granularity and statically require it
-            steps = -(-min(-(-max_nm // 256) * 256, 2 * Lq) // 256) * 256
+            steps = -(-min(-(-max_nm // 128) * 128, 2 * Lq) // 128) * 128
             from ..parallel import partition_balanced
             total_pairs = sum(len(w.layers) for _, w in live)
             n_groups = max(self.num_batches,
