@@ -178,9 +178,12 @@ def bench_scale():
         win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * 500)
         for _ in range(30):
             layer = truth.copy()
-            flips = rng.random(500) < 0.12
+            flips = rng.random(500) < 0.08
             layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
             layer = np.delete(layer, rng.integers(0, len(layer), 12))
+            ins_at = rng.integers(0, len(layer), 12)
+            layer = np.insert(layer, ins_at,
+                              bases[rng.integers(0, 4, 12)])
             win.add_layer(layer.tobytes(), b"9" * len(layer), 0, 499)
         windows.append(win)
 

@@ -546,7 +546,8 @@ def pallas_ok() -> bool:
 
             # fused walk+vote path must land on identical vote matrices
             if ok:
-                from .poa import (CH, DEL, _scatter_votes, _vote_from_ops)
+                from .poa import (CH, DEL, _accumulate_votes,
+                                  _vote_from_ops)
                 L, K, nW = max_len, 4, 4
                 qcodes = jnp.asarray(
                     rng.integers(0, 5, (B, max_len)).astype(np.uint8))
@@ -555,18 +556,21 @@ def pallas_ok() -> bool:
                 bg = jnp.asarray(rng.integers(0, 8, B).astype(np.int32))
                 win_of = jnp.asarray(
                     (np.arange(B) % (nW - 1)).astype(np.int32))
-                wx, ux, okx = _vote_from_ops(
+                idxx, wx8, okx = _vote_from_ops(
                     jnp.asarray(ox), jnp.asarray(fix), jnp.asarray(fjx),
                     jnp.asarray(sx), args[2], args[3], qcodes, qweights,
-                    bg, win_of, n_windows=nW, max_len=max_len, band=band,
-                    L=L, K=K)
+                    bg, max_len=max_len, band=band, L=L, K=K)
+                wx, ux, _ovx = _accumulate_votes(
+                    idxx, wx8, okx, win_of, args[3], bg, n_windows=nW,
+                    L=L, K=K, band=band)
                 idx, w8, fiv, fjv = pallas_walk_vote(
                     jnp.asarray(dp), args[2], args[3], bg, qcodes,
                     qweights, band=band, L=L, K=K, CH=CH, DEL=DEL)
                 okv = ((fiv == 0) & (fjv == 0)
                        & (jnp.asarray(sp) < (band // 2)))
-                wp, up = _scatter_votes(idx, w8, okv, win_of,
-                                        n_windows=nW, VOT=L * (1 + K) * CH)
+                wp, up, _ovp = _accumulate_votes(
+                    idx, w8.astype(jnp.int32), okv, win_of, args[3], bg,
+                    n_windows=nW, L=L, K=K, band=band)
                 ok = (np.array_equal(np.asarray(wx), np.asarray(wp))
                       and np.array_equal(np.asarray(ux), np.asarray(up)))
             _PALLAS_OK = ok
@@ -650,7 +654,11 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
                 op == 0, col * CH + base,
                 jnp.where(op == 2, col * CH + DEL,
                           (L + col * K + slot_i) * CH + base))
-            valid = active & (j >= 1) & (col >= 0) & (col < L)
+            # drop-collapse: an insertion run votes only its last K bases
+            # (keeps every vote address's count bounded by layer depth,
+            # which the packed-u32 accumulation relies on)
+            valid = (active & (j >= 1) & (col >= 0) & (col < L)
+                     & ~((op == 1) & (run >= K)))
             addr = jnp.where(valid, addr, VOT)
             wv = jnp.where(valid, wq, 0)
             run = jnp.where(active, jnp.where(op == 1, run + 1, 0), run)

@@ -17,7 +17,11 @@ one-block-per-group graph POA, consensus is computed as a
    walk+vote kernel (``pallas_walk_vote``) emits each step's vote address
    and weight directly from registers; the XLA path reconstructs them
    from op codes with vectorized prefix sums (``_vote_from_ops``); both
-   land on bit-identical matrices via one shared scatter-add;
+   streams land on bit-identical matrices via the shared TPU-native
+   accumulation ``_accumulate_votes`` (stable binary-routed compaction +
+   per-row alignment + one-hot MXU matmul for the column votes, a folded
+   packed scatter for the rare insertion votes — a flat scatter-add here
+   costs more than the alignment kernels themselves);
 3. consensus = per-column argmax over weighted base votes, a column
    dropped when deletion weight exceeds ``del_beta`` x the summed base
    weights, and insertion slot ``s`` emitted when its summed weight
@@ -25,11 +29,12 @@ one-block-per-group graph POA, consensus is computed as a
    with per-base unweighted coverage for the reference's TGS end-trimming
    contract (``src/window.cpp:118-139``);
 4. the emitted consensus becomes the next round's backbone **on device**:
-   ``refine_round`` rebuilds the backbone rows (prefix-sum positions + one
-   scatter) and remaps every layer span through the emitted-column map, so
-   the refinement loop runs ``rounds`` times with no host round-trip — the
-   host packs once and fetches once (the tunnel to the device costs
-   ~0.2-0.3 s per transfer, which used to dominate wall-clock).
+   ``refine_round`` rebuilds the backbone rows (the emitted entries
+   compact to their prefix-sum positions) and remaps every layer span
+   through the emitted-column map; ``refine_loop`` runs all ``rounds``
+   rounds in ONE dispatch — the host packs once, dispatches once and
+   fetches once per group (the tunnel costs ~0.1-0.3 s per round-trip,
+   which used to dominate wall-clock).
 
 Like the reference's GPU path, this engine is allowed to differ slightly
 from the CPU spoa-semantics engine (upstream records separate CUDA goldens:
@@ -39,13 +44,13 @@ engine, mirroring ``StatusType`` rejects (``src/cuda/cudabatch.cpp:135-156``).
 
 Emission thresholds (``ins_theta``/``del_beta``) and the refinement round
 count were calibrated against the CPU engine on λ-phage: the recorded
-device golden is 1351 vs CPU 1324 (+2.0%, PAF input — bit-identical on
+device golden is 1346 vs CPU 1324 (+1.7%, PAF input — bit-identical on
 real TPU v5e and the XLA CPU mesh), well inside the reference's own
 accelerated-path divergence (cudapoa 1385 vs spoa 1312, +5.6%,
 ``test/racon_test.cpp:312``).
 
 Engine caps (documented, per ADVICE round 1): insertion runs longer than
-``K_INS`` collapse extra bases into the last slot, and insertions before
+``K_INS`` vote only their last ``K_INS`` bases, and insertions before
 the first backbone column of a window (junction "-1") only have a vote
 slot when the layer starts past column 0; refinement rounds recover most
 of both effects. A backbone that grows past its fixed device buffer
@@ -98,26 +103,27 @@ _BYTE_LUT = np.frombuffer(b"ACGTN-", dtype=np.uint8)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_len", "band", "L", "K", "n_windows"))
-def _vote_from_ops(ops, fi, fj, score, n, m, qcodes, qweights, begin, win_of,
-                   *, n_windows: int, max_len: int, band: int, L: int, K: int):
-    """Turn walked op codes into scatter-added weighted votes — vectorized.
+                   static_argnames=("max_len", "band", "L", "K"))
+def _vote_from_ops(ops, fi, fj, score, n, m, qcodes, qweights, begin,
+                   *, max_len: int, band: int, L: int, K: int):
+    """Turn walked op codes into the (idx, w, ok) vote stream — vectorized.
 
     ops: uint8 [B, S] backward-walk op codes from ``_walk_ops_kernel``
     (0=M, 1=I, 2=D, >=3 done/stalled); qcodes/qweights: [B, max_len] layer
-    base codes and weights; begin: [B] backbone-span start column; win_of:
-    [B] owning window index.
+    base codes and weights; begin: [B] backbone-span start column.
 
     The walk position *before* step t is recovered with prefix sums of the
     consumed-query/-target indicators (no sequential re-walk), the
     insertion-run length with a prefix max over the last non-insertion
     step, and the layer base/weight lookups are one batched gather each —
-    everything is [B, S] elementwise work, which XLA fuses into a handful
-    of passes instead of S tiny scan steps.
+    everything is [B, S] elementwise work. The XLA twin of the fused
+    Pallas emitter (``pallas_walk_vote``): both produce the identical
+    stream consumed by :func:`_accumulate_votes`.
 
-    Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted same-shape
-    i32, ok [B] bool). Vote layout: column votes at col*CH+ch, insertion
-    slot s of junction col at (L + col*K + s)*CH + ch.
+    Vote layout: column votes at col*CH+ch, insertion slot s of junction
+    col at (L + col*K + s)*CH + ch, sink VOT for non-votes. Insertion
+    runs longer than K vote only their last K bases (the rest are
+    dropped), which bounds every vote address's count at the layer depth.
     """
     B, S = ops.shape
     Lq = max_len
@@ -144,39 +150,189 @@ def _vote_from_ops(ops, fi, fj, score, n, m, qcodes, qweights, begin, win_of,
     base = jnp.take_along_axis(qcodes, qpos, axis=1).astype(jnp.int32)
     # weights travel as uint8 (integral 0..93 phred, or 1 for no-quality
     # layers) — identical values to the Pallas emitter's
-    wgt = jnp.take_along_axis(qweights, qpos, axis=1).astype(jnp.float32)
+    wgt = jnp.take_along_axis(qweights, qpos, axis=1).astype(jnp.int32)
     col = begin[:, None] + j_t - 1
     # vote target: M -> (col, base); D -> (col, DEL); I -> ins slot
     idx = jnp.where(
         is_M, col * CH + base,
         jnp.where(is_D, col * CH + DEL,
                   (L + col * K + slot) * CH + base))
-    valid = (ops < 3) & (j_t >= 1) & (col >= 0) & (col < L)
+    valid = ((ops < 3) & (j_t >= 1) & (col >= 0) & (col < L)
+             & ~(is_I & (ins_run >= K)))
     idx = jnp.where(valid, idx, VOT)  # sink
-    w = jnp.where(valid, wgt, 0.0)
+    w = jnp.where(valid, wgt, 0)
 
     ok = (fi == 0) & (fj == 0) & (score < (band // 2))
-    weighted, unweighted = _scatter_votes(idx, w, ok, win_of,
-                                          n_windows=n_windows, VOT=VOT)
-    return weighted, unweighted, ok
+    return idx, w, ok
 
 
-def _scatter_votes(idx, w, ok, win_of, *, n_windows: int, VOT: int):
-    """Scatter-add per-step votes (local address ``idx`` or sink ``VOT``,
-    weight ``w``) into per-window weighted/unweighted matrices — the
-    accumulation shared by the XLA vote prep and the fused Pallas walk.
-    Weights are integral, so the float sums are exact and independent of
-    scatter order (both producers land on identical matrices)."""
-    wsv = w.astype(jnp.float32) * ok[:, None].astype(jnp.float32)
-    flat_idx = (win_of[:, None] * (VOT + 1) + idx).reshape(-1)
-    weighted = jnp.zeros(n_windows * (VOT + 1), jnp.float32)
-    weighted = weighted.at[flat_idx].add(wsv.reshape(-1))
-    unweighted = jnp.zeros(n_windows * (VOT + 1), jnp.int32)
-    unweighted = unweighted.at[flat_idx].add(
-        (wsv.reshape(-1) > 0).astype(jnp.int32))
-    weighted = weighted.reshape(n_windows, VOT + 1)[:, :VOT]
-    unweighted = unweighted.reshape(n_windows, VOT + 1)[:, :VOT]
-    return weighted, unweighted
+def _shift_left(x, sh: int):
+    """Shift lanes toward index 0 by static ``sh``, zero-filling the tail
+    (unlike ``jnp.roll`` nothing wraps)."""
+    return jnp.pad(x[:, sh:], ((0, 0), (0, sh)))
+
+
+def _compact_rows(flag, payload, S: int):
+    """Stable per-row compaction: move flagged lanes to [0, rank) keeping
+    order; unflagged output lanes are zero. ``payload`` is one int32 array
+    (or a tuple of them, routed together) of nonnegative values — callers
+    bit-pack what they need.
+
+    Routing is LSB-first binary shifting: pass k moves items whose
+    remaining distance has bit k by 2**k lanes. Destinations are the
+    strictly-increasing ranks and distances d = t - rank are
+    non-decreasing over flagged items, which makes every pass
+    collision-free: a mover landing on a stayer would need
+    d_j - d_i = c*2^k (c >= 1) with both ranks r_j > r_i and
+    r_j - r_i = (1 - c)*2^k <= 0 — a contradiction. ~log2(S) elementwise
+    passes; no scatter, no gather."""
+    B = flag.shape[0]
+    single = not isinstance(payload, tuple)
+    pays = (payload,) if single else payload
+    f = flag.astype(jnp.int32)
+    rank = jnp.cumsum(f, axis=1) - f
+    t_idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    d = jnp.where(flag, t_idx - rank, 0)
+    alive = flag
+    pays = tuple(jnp.where(flag, p, 0) for p in pays)
+    for k in range((S - 1).bit_length()):
+        sh = 1 << k
+        if sh >= S:
+            break
+        mov = alive & (((d >> k) & 1) == 1)
+        stay = alive & ~mov
+        mov_s = _shift_left(mov, sh)
+        d_s = _shift_left(d, sh)
+        pays_s = tuple(_shift_left(p, sh) for p in pays)
+        alive = mov_s | stay
+        d = jnp.where(mov_s, d_s, jnp.where(stay, d, 0))
+        pays = tuple(jnp.where(mov_s, ps, jnp.where(stay, p, 0))
+                     for ps, p in zip(pays_s, pays))
+    out = pays[0] if single else pays
+    return out, alive
+
+
+def _shift_rows_left(x, amount, max_amount: int):
+    """Per-row left shift by a traced per-row ``amount`` (binary
+    decomposition of the shift into static-shift selects; zero fill)."""
+    for k in range(max(max_amount, 1).bit_length()):
+        sh = 1 << k
+        if sh > max_amount:
+            break
+        x = jnp.where((((amount >> k) & 1) == 1)[:, None],
+                      _shift_left(x, sh), x)
+    return x
+
+
+def _accumulate_votes(idx, w, ok, win_of, span_m, bg, *, n_windows: int,
+                      L: int, K: int, band: int):
+    """Accumulate the per-step vote stream into per-window matrices —
+    shared by both walk backends (identical results by construction).
+
+    TPU-native replacement for a flat scatter-add (XLA's scatter engine
+    processes the ~10M updates of a full-size group at ~90M/s, an order
+    of magnitude over everything else in the round):
+
+    - **column votes** (M/D steps, one per consumed backbone column, the
+      ~98% majority): the r-th column-consuming step of a pair hits
+      column ``bg + m - 1 - r``, so a stable per-row compaction
+      (:func:`_compact_rows`) followed by a lane reverse and a per-row
+      shift lands every vote at its absolute column; a one-hot
+      [B, n_windows] matmul (exact: integer values < 2^24 in f32 with
+      HIGHEST precision) then reduces pairs into windows on the MXU;
+    - **insertion votes** (~2%): compacted to the first ``band//2`` lanes
+      (an ok pair has score < band//2, so it cannot carry more insertion
+      steps than that) and scatter-added with the weight and the count
+      packed into one u32 cell — counts are bounded by the layer depth
+      (drop-collapse rule), so the fields cannot carry into each other.
+
+    Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted i32).
+    """
+    B, S = idx.shape
+    VOT = L * (1 + K) * CH
+    nW = n_windows
+
+    col_flag = idx < L * CH
+    ins_flag = (idx >= L * CH) & (idx < VOT)
+
+    # ---- column votes: compact to rank space, reverse, align, matmul
+    ch = idx & (CH - 1)  # CH is a power of two
+    pay = (ch << 13) | jnp.minimum(w, (1 << 13) - 1)
+    comp, _ = _compact_rows(col_flag, pay, S)
+    W2 = max(S, L)
+    if W2 > S:
+        comp = jnp.pad(comp, ((0, 0), (0, W2 - S)))
+    rev = jnp.flip(comp, axis=1)
+    aligned = _shift_rows_left(rev, W2 - bg - span_m, W2)[:, :L]
+    a_ch = (aligned >> 13) & (CH - 1)
+    a_w = (aligned & ((1 << 13) - 1)).astype(jnp.float32)
+    ch_iota = jnp.arange(CH, dtype=jnp.int32)
+    wop = jnp.where(a_ch[:, :, None] == ch_iota, a_w[:, :, None], 0.0)
+    cop = (wop > 0).astype(jnp.float32)
+    onehot = ((win_of[:, None] == jnp.arange(nW, dtype=win_of.dtype))
+              & ok[:, None]).astype(jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    w_cols = jnp.matmul(onehot.T, wop.reshape(B, L * CH), precision=hi)
+    c_cols = jnp.matmul(onehot.T, cop.reshape(B, L * CH), precision=hi)
+
+    # ---- insertion votes: two-level compaction, then one packed scatter
+    # level 1 (per pair): an ok pair has < band//2 edits, hence < band//2
+    # insertion steps — lanes beyond IC can only hold votes of pairs that
+    # are dropped anyway
+    IC = min(S, band // 2)
+    ipay = ((idx - L * CH) << 13) | jnp.minimum(w, (1 << 13) - 1)
+    icomp, ialive = _compact_rows(ins_flag, ipay, S)
+    icomp = icomp[:, :IC]
+    ialive = ialive[:, :IC]
+    iaddr = icomp >> 13
+    iw = ((icomp & ((1 << 13) - 1))
+          * (ialive & ok[:, None]).astype(jnp.int32))
+    # live = lanes that actually carry weight: rejected pairs' and
+    # zero-weight lanes must not occupy fold-cap slots (they'd trip the
+    # overflow fallback without representing any real vote density)
+    ialive = ialive & ok[:, None] & (iw > 0)
+    INS = L * K * CH
+    iflat = jnp.where(ialive, win_of[:, None] * INS + iaddr, nW * INS)
+    # level 2: fold G pairs per row and compact again — real insertions
+    # are a few percent of steps, so folded rows compact ~CAP_DIV-fold
+    # and the scatter engine (the slowest op on TPU at ~90M updates/s)
+    # scans CAP_DIV x fewer lanes. A fold row can overflow its cap when
+    # its G pairs average > IC/CAP_DIV insertions each (e.g. one very
+    # divergent window's layers packed together): votes are never lost —
+    # overflow switches that round to scattering the uncapped level-1
+    # stream (lax.cond compiles both, the fast path runs when clean);
+    # the returned tally counts the overflowing items for telemetry.
+    def pack_scatter(flat, w):
+        val = w.astype(jnp.uint32) + ((w > 0).astype(jnp.uint32) << 23)
+        return jnp.zeros(nW * INS + 1, jnp.uint32
+                         ).at[flat.reshape(-1)].add(val.reshape(-1))
+
+    G, CAP_DIV = 32, 4
+    if B % G == 0 and (G * IC) % CAP_DIV == 0:
+        rows = B // G
+        cap = G * IC // CAP_DIV
+        f2 = iflat.reshape(rows, G * IC)
+        w2 = iw.reshape(rows, G * IC)
+        (f2, w2), alive2 = _compact_rows(
+            ialive.reshape(rows, G * IC), (f2, w2), G * IC)
+        ins_overflow = jnp.sum((alive2[:, cap:] & (w2[:, cap:] > 0)
+                                ).astype(jnp.int32))
+        itab = lax.cond(
+            ins_overflow == 0,
+            lambda: pack_scatter(
+                jnp.where(alive2[:, :cap], f2[:, :cap], nW * INS),
+                w2[:, :cap]),
+            lambda: pack_scatter(iflat, iw))
+    else:  # tiny batches: skip the fold
+        itab = pack_scatter(iflat, iw)
+        ins_overflow = jnp.int32(0)
+    itab = itab[:nW * INS]
+    ins_w = (itab & ((1 << 23) - 1)).astype(jnp.float32).reshape(nW, INS)
+    ins_c = (itab >> 23).astype(jnp.int32).reshape(nW, INS)
+
+    weighted = jnp.concatenate([w_cols, ins_w], axis=1)
+    unweighted = jnp.concatenate([c_cols.astype(jnp.int32), ins_c], axis=1)
+    return weighted, unweighted, ins_overflow
 
 
 @functools.partial(jax.jit, static_argnames=("L", "K"))
@@ -235,12 +391,12 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
 
 @functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
                                              "Lb", "K", "steps",
-                                             "use_pallas"))
+                                             "use_pallas", "Lq2"))
 def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
                  bcodes, bweights, blen, covs, ever, frozen, dropped,
                  ins_theta, del_beta, *, n_windows: int, max_len: int,
                  band: int, Lb: int, K: int, steps: int = 0,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, Lq2: int = 0):
     """One fully-device-resident refinement round.
 
     Align every layer against its current backbone span, vote, pick
@@ -255,12 +411,18 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     Per-window state: ``bcodes/bweights/blen`` backbone rows (codes, Lb
     columns), ``covs`` coverage of the current backbone, ``ever`` whether
     any round succeeded (false -> CPU fallback), ``frozen`` stop-refining
-    flag (backbone outgrew Lb). ``dropped`` accumulates rejected layer
-    alignments ([1] i32). The single source of truth for the round wiring,
-    wrapped unchanged by the ``shard_map`` path
-    (``racon_tpu.parallel.sharded_refine_round``).
+    flag (backbone outgrew Lb). ``dropped`` accumulates telemetry
+    counters ([nd, 3] i32: rejected layer alignments, sweep-truncated
+    spans, fold-overflow insertion votes — the last never lose votes,
+    they switch the round to the uncapped scatter). The single source of truth for the round wiring,
+    wrapped by :func:`refine_loop` (all rounds in one dispatch) and the
+    ``shard_map`` path (``racon_tpu.parallel.sharded_refine_loop``).
     """
     Lq = max_len
+    # the vote emitters only read query lanes < the longest real layer —
+    # slicing their blocks to Lq2 cuts the fused kernel's per-step
+    # base/weight selects by Lq/Lq2 (the fwd row layout still needs Lq)
+    Lq2 = Lq2 or Lq
     c = band // 2
     width = c + Lq + band
     B = qcodes.shape[0]
@@ -291,25 +453,35 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
         from .pallas_nw import pallas_nw_fwd, pallas_walk_vote
         packed, score = pallas_nw_fwd(qrp, tp, n, m,
                                       max_len=Lq, band=band, steps=steps)
-        idx, w8, fi, fj = pallas_walk_vote(packed, n, m, bg, qcodes,
-                                           qweights, band=band, L=Lb,
-                                           K=K, CH=CH, DEL=DEL)
+        idx, w8, fi, fj = pallas_walk_vote(packed, n, m, bg,
+                                           qcodes[:, :Lq2],
+                                           qweights[:, :Lq2], band=band,
+                                           L=Lb, K=K, CH=CH, DEL=DEL)
         okp = (fi == 0) & (fj == 0) & (score < (band // 2))
-        weighted, unweighted = _scatter_votes(
-            idx, w8, okp, win_of, n_windows=n_windows,
-            VOT=Lb * (1 + K) * CH)
+        wv = w8.astype(jnp.int32)
     else:
         packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                              max_len=Lq, band=band,
                                              steps=steps)
         ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band)
-        weighted, unweighted, okp = _vote_from_ops(
-            ops, fi, fj, score, n, m, qcodes, qweights, bg, win_of,
-            n_windows=n_windows, max_len=Lq, band=band, L=Lb, K=K)
+        idx, wv, okp = _vote_from_ops(
+            ops, fi, fj, score, n, m, qcodes[:, :Lq2], qweights[:, :Lq2],
+            bg, max_len=Lq2, band=band, L=Lb, K=K)
+    weighted, unweighted, ins_ovf = _accumulate_votes(
+        idx, wv, okp, win_of, m, bg, n_windows=n_windows, L=Lb, K=K,
+        band=band)
     winner, coverage, ins_winner, ins_emit, ins_cov = _consensus_kernel(
         weighted, unweighted, bcodes, bweights, blen, ins_theta, del_beta,
         L=Lb, K=K)
-    dropped = dropped + jnp.sum((~okp) & real)
+    # telemetry: [0] total dropped layer alignments, [1] the subset whose
+    # span outgrew the sweep bound (n + m > steps keeps the walk from
+    # finishing — a quality cliff distinct from band escapes, ADVICE r3),
+    # [2] insertion votes past the fold-compaction cap (not lost — the
+    # round fell back to the uncapped level-1 scatter)
+    dropped = dropped + jnp.stack(
+        [jnp.sum((~okp) & real),
+         jnp.sum(real & (n + m > steps)),
+         ins_ovf])[None, :]
 
     # ---- rebuild backbone rows from emitted columns/slots.
     # Entry order within a column: its base first, then insertion slots
@@ -331,14 +503,15 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     new_len = jnp.sum(fe, axis=1)
     c2n = pos[:, ::(1 + K)]                     # old col -> new position
 
-    tgt = jnp.where((fe > 0) & (pos < Lb), pos, Lb)  # overflow/pad -> sink
-    rows = (jnp.arange(n_windows, dtype=jnp.int32)[:, None] * (Lb + 1)
-            + tgt).reshape(-1)
-    nb_mat = jnp.zeros(n_windows * (Lb + 1), jnp.uint8).at[rows].set(
-        ent_code.reshape(-1)).reshape(n_windows, Lb + 1)[:, :Lb]
-    nc_mat = jnp.zeros(n_windows * (Lb + 1), jnp.int32).at[rows].set(
-        ent_cov.reshape(n_windows, E).reshape(-1)).reshape(
-            n_windows, Lb + 1)[:, :Lb]
+    # emitted entries compact to their output columns (ranks == the
+    # prefix-sum positions, entries past Lb fall off the slice) — same
+    # routing primitive as the vote accumulation, no scatter. Packing:
+    # codes fit 3 bits; covs are winner-channel counts <= depth+1.
+    epay = ((ent_cov.reshape(n_windows, E).astype(jnp.int32) << 3)
+            | ent_code.reshape(n_windows, E).astype(jnp.int32))
+    ecomp, _ = _compact_rows(fe > 0, epay, E)
+    nb_mat = (ecomp[:, :Lb] & 7).astype(jnp.uint8)
+    nc_mat = ecomp[:, :Lb] >> 3
 
     # empty consensus keeps the previous state (host analog: `continue`);
     # overflow freezes the window at its last refined backbone
@@ -370,6 +543,31 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     blen = jnp.where(ok_upd, new_len, blen)
 
     return bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped
+
+
+@functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
+                                             "max_len", "band", "Lb", "K",
+                                             "steps", "use_pallas",
+                                             "Lq2"))
+def refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
+                bcodes, bweights, blen, covs, ever, frozen, dropped,
+                ins_theta, del_beta, *, rounds: int, n_windows: int,
+                max_len: int, band: int, Lb: int, K: int, steps: int = 0,
+                use_pallas: bool = False, Lq2: int = 0):
+    """All refinement rounds of a group in ONE device dispatch.
+
+    ``lax.fori_loop`` over :func:`refine_round` — per-round host
+    dispatches over the tunnel (~0.1 s each) otherwise rival the device
+    time of a round; with the loop on device a group costs one dispatch
+    and one fetch regardless of ``rounds``."""
+    def body(_, state):
+        return refine_round(
+            n, qcodes, qweights, win_of, real, *state, ins_theta, del_beta,
+            n_windows=n_windows, max_len=max_len, band=band, Lb=Lb, K=K,
+            steps=steps, use_pallas=use_pallas, Lq2=Lq2)
+
+    state = (bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped)
+    return lax.fori_loop(0, rounds, body, state)
 
 
 class _Work:
@@ -424,7 +622,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # silently ignoring them.
         scale = max(abs(gap), 1) / abs(DEFAULT_GAP)
         self.ins_theta = min(ins_theta * scale, 0.95)
-        self.del_beta = del_beta * scale
+        # cap mirrors the ins_theta cap: past it a stronger -g would make
+        # column deletion effectively impossible while insertions saturate
+        # at 0.95, an asymmetry users tuning -g don't expect (ADVICE r3)
+        self.del_beta = min(del_beta * scale, 2.5)
         if (match, mismatch) != (DEFAULT_MATCH, DEFAULT_MISMATCH):
             import warnings
             warnings.warn(
@@ -438,7 +639,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # dispatch), so host packing overlaps device compute.
         self.num_batches = max(1, num_batches)
         self.stats = {"device_windows": 0, "fallback_windows": 0,
-                      "dropped_layers": 0, "passthrough": 0}
+                      "dropped_layers": 0, "sweep_truncated": 0,
+                      "ins_overflow": 0, "passthrough": 0}
 
     # -------------------------------------------------------------- public
 
@@ -460,7 +662,12 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
         if live:
             max_bb = max(len(w.backbone) for _, w in live)
-            L = max(256, -(-max_bb // 256) * 256)
+            # device ceiling: the packed insertion payload holds
+            # addr << 13 in an int32, so Lb*K_INS*CH must fit 18 bits
+            # (Lb <= 8192); longer backbones take the CPU fallback like
+            # any other reject
+            max_dev_L = (1 << 18) // (K_INS * CH) - GROW
+            L = max(256, min(-(-max_bb // 256) * 256, max_dev_L))
             Lq = L + self.band
             Lb = min(L + GROW, Lq)  # backbone buffer (span fit: Lb <= Lq)
             # windows whose layers exceed the pair buffer (or backbones the
@@ -480,6 +687,9 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # multiple of 128: the Pallas kernels chunk/flush at 128-lane
             # granularity and statically require it
             steps = -(-min(-(-max_nm // 128) * 128, 2 * Lq) // 128) * 128
+            # vote-kernel query-block width: longest real layer, padded
+            max_n = max(len(s) for _, w in live for s, _, _, _ in w.layers)
+            Lq2 = min(Lq, -(-max_n // 128) * 128)
             from ..parallel import partition_balanced
             total_pairs = sum(len(w.layers) for _, w in live)
             n_groups = max(self.num_batches,
@@ -494,21 +704,20 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # device at once (launch group k+1, then fetch group
             # k-num_batches), so peak HBM is per-group, like cudapoa's
             # fixed per-batch memory (cudapolisher.cpp:219-228)
-            total_units = len(groups) * self.rounds + 1
+            total_units = len(groups) + 1
             self._last_total_units = total_units
             done_units = 0
             inflight = []
             for g in groups:
                 la = self._launch_group(g, Lq, Lb)
-                for rnd in range(self.rounds):
-                    self._round(la, Lq, Lb, steps)
-                    done_units += 1
-                    if progress is not None:
-                        # ticks show rounds entering the device pipeline
-                        # (dispatch is async; only fetches block — syncing
-                        # per round would reintroduce the tunnel
-                        # round-trips this engine exists to avoid)
-                        progress(done_units, total_units)
+                self._rounds(la, Lq, Lb, steps, Lq2)
+                done_units += 1
+                if progress is not None:
+                    # ticks show groups entering the device pipeline
+                    # (dispatch is async; only fetches block — syncing
+                    # mid-group would reintroduce the tunnel round-trips
+                    # this engine exists to avoid)
+                    progress(done_units, total_units)
                 inflight.append(la)
                 if len(inflight) > self.num_batches:
                     self._finish_group(inflight.pop(0), trim, results)
@@ -629,13 +838,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
         covs = jnp.zeros((nd * nWp, Lb), jnp.int32)
         ever = jnp.zeros(nd * nWp, bool)
         frozen = jnp.zeros(nd * nWp, bool)
-        dropped = jnp.zeros(nd, jnp.int32)
+        # telemetry row per shard: [dropped, sweep-truncated, ins-overflow]
+        dropped = jnp.zeros((nd, 3), jnp.int32)
         state = [bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped]
         return {"shards": shards, "static": static, "state": state,
                 "nWp": nWp, "nd": nd}
 
-    def _round(self, launch, Lq, Lb, steps) -> None:
-        """Dispatch one refinement round for a group (no host sync).
+    def _rounds(self, launch, Lq, Lb, steps, Lq2=0) -> None:
+        """Dispatch a group's full refinement loop (no host sync).
 
         The Pallas availability probe runs at one small shape, so a Mosaic
         compile failure at the production shape (e.g. an exotic band or a
@@ -644,30 +854,33 @@ class TpuPoaConsensus(PallasDispatchMixin):
         instead of aborting the polish (jit compilation is eager, so
         only compile errors are catchable here; numerics are covered by
         the probe's bit-exact comparison)."""
-        shape_key = (Lq, self.band, steps, Lb)
+        shape_key = (Lq, self.band, steps, Lb, Lq2)
         if self._use_pallas(shape_key):
             try:
-                self._dispatch_round(launch, Lq, Lb, steps, True)
+                self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, True)
                 return
             except Exception as e:
                 self._note_pallas_failure(shape_key, e)
-        self._dispatch_round(launch, Lq, Lb, steps, False)
+        self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, False)
 
-    def _dispatch_round(self, launch, Lq, Lb, steps, use_pallas) -> None:
+    def _dispatch_rounds(self, launch, Lq, Lb, steps, Lq2,
+                         use_pallas) -> None:
         static, state = launch["static"], launch["state"]
         theta = jnp.float32(self.ins_theta)
         beta = jnp.float32(self.del_beta)
         if launch["nd"] == 1:
-            out = refine_round(
-                *static, *state, theta, beta,
+            out = refine_loop(
+                *static, *state, theta, beta, rounds=self.rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=self.band,
-                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas)
+                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
+                Lq2=Lq2)
         else:
-            from ..parallel import sharded_refine_round
-            out = sharded_refine_round(
-                self.mesh, static, state, theta, beta,
+            from ..parallel import sharded_refine_loop
+            out = sharded_refine_loop(
+                self.mesh, static, state, theta, beta, rounds=self.rounds,
                 n_windows_local=launch["nWp"], max_len=Lq, band=self.band,
-                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas)
+                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
+                Lq2=Lq2)
         launch["state"] = list(out)
 
     def _finish_group(self, launch, trim: bool, results) -> None:
@@ -678,7 +891,9 @@ class TpuPoaConsensus(PallasDispatchMixin):
         _, _, bcodes, _, blen, covs, ever, _, dropped = launch["state"]
         bcodes, blen, covs, ever, dropped = jax.device_get(
             [bcodes, blen, covs, ever, dropped])
-        self.stats["dropped_layers"] += int(dropped.sum())
+        self.stats["dropped_layers"] += int(dropped[:, 0].sum())
+        self.stats["sweep_truncated"] += int(dropped[:, 1].sum())
+        self.stats["ins_overflow"] += int(dropped[:, 2].sum())
         for s, sh in enumerate(shards):
             for wi, (i, w) in enumerate(sh):
                 row = s * nWp + wi

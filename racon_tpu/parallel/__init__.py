@@ -16,8 +16,8 @@ Public surface:
 - :func:`get_mesh` — build a 1-D mesh over (a prefix of) the local devices;
 - :func:`sharded_align` — batched wavefront-NW + on-device traceback,
   batch dim sharded (used by :class:`racon_tpu.ops.nw.TpuAligner`);
-- :func:`sharded_refine_round` — one device-resident consensus refinement
-  round with pair arrays and window state co-sharded (used by
+- :func:`sharded_refine_loop` — a group's device-resident consensus
+  refinement loop with pair arrays and window state co-sharded (used by
   :class:`racon_tpu.ops.poa.TpuPoaConsensus`);
 - :func:`partition_balanced` — greedy LPT binning of variable-cost items
   into per-shard groups (host-side analog of the reference's dynamic work
@@ -98,20 +98,20 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
-                       band: int, Lb: int, K: int, steps: int,
-                       use_pallas: bool):
-    from ..ops.poa import refine_round
+def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
+                       max_len: int, band: int, Lb: int, K: int,
+                       steps: int, use_pallas: bool, Lq2: int):
+    from ..ops.poa import refine_loop
 
     def local(n, qcodes, qweights, win_of, real, bg, ed,
               bcodes, bweights, blen, covs, ever, frozen, dropped,
               ins_theta, del_beta):
-        return refine_round(n, qcodes, qweights, win_of, real, bg, ed,
-                            bcodes, bweights, blen, covs, ever, frozen,
-                            dropped, ins_theta, del_beta,
-                            n_windows=n_windows_local, max_len=max_len,
-                            band=band, Lb=Lb, K=K, steps=steps,
-                            use_pallas=use_pallas)
+        return refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
+                           bcodes, bweights, blen, covs, ever, frozen,
+                           dropped, ins_theta, del_beta, rounds=rounds,
+                           n_windows=n_windows_local, max_len=max_len,
+                           band=band, Lb=Lb, K=K, steps=steps,
+                           use_pallas=use_pallas, Lq2=Lq2)
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(
@@ -119,23 +119,25 @@ def _sharded_refine_fn(mesh: Mesh, n_windows_local: int, max_len: int,
         out_specs=(spec,) * 9, check_vma=False))
 
 
-def sharded_refine_round(mesh: Mesh, static, state, ins_theta, del_beta, *,
-                         n_windows_local: int, max_len: int, band: int,
-                         Lb: int, K: int, steps: int = 0,
-                         use_pallas: bool = False):
-    """One device-resident refinement round over a co-sharded batch.
+def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
+                        rounds: int, n_windows_local: int, max_len: int,
+                        band: int, Lb: int, K: int, steps: int = 0,
+                        use_pallas: bool = False, Lq2: int = 0):
+    """A group's whole refinement loop over a co-sharded batch, one
+    dispatch (the shard-local body is ``refine_loop``'s fori over
+    ``refine_round``).
 
     ``static`` = (n, qcodes, qweights, win_of, real) with leading dim
     ``n_shards * B_local``; ``win_of`` holds **shard-local** window
     ordinals.  ``state`` = (bg, ed, bcodes, bweights, blen, covs, ever,
     frozen, dropped) — pair-major arrays share the pair stacking, window
     rows have leading dim ``n_shards * n_windows_local``, ``dropped`` is
-    one counter per shard.  Pairs belonging to one window must live in
-    that window's shard — :func:`partition_balanced` plus per-shard
-    packing guarantees it, so no cross-shard reduction is needed and the
-    whole refinement loop scales collective-free.  Returns the updated
-    ``state`` stacked the same way.
+    a [n_shards, 3] telemetry row per shard.  Pairs belonging to one
+    window must live in that window's shard — :func:`partition_balanced`
+    plus per-shard packing guarantees it, so no cross-shard reduction is
+    needed and the whole refinement loop scales collective-free.  Returns
+    the updated ``state`` stacked the same way.
     """
-    fn = _sharded_refine_fn(mesh, n_windows_local, max_len, band, Lb, K,
-                            steps, use_pallas)
+    fn = _sharded_refine_fn(mesh, rounds, n_windows_local, max_len, band,
+                            Lb, K, steps, use_pallas, Lq2)
     return fn(*static, *state, ins_theta, del_beta)

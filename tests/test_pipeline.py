@@ -78,12 +78,13 @@ def test_consensus_fasta_paf_golden(data_dir):
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
 def test_device_consensus_quality(data_dir):
     """Device (TpuPoaConsensus) pipeline quality: like the reference's CUDA
-    goldens, the accelerated engine records its own target — 1351 vs CPU
+    goldens, the accelerated engine records its own target — 1346 vs CPU
     1324 (reference: cudapoa 1385 vs spoa 1312,
-    ``test/racon_test.cpp:312``). Vote weights are integral, so float
-    scatter sums are exact and order-independent — the XLA kernels on
-    this CPU mesh land on the same bytes as the Pallas kernels on real
-    TPU, and the chip golden holds exactly here too."""
+    ``test/racon_test.cpp:312``). Vote weights are integral and the
+    accumulation (column-vote matmul + packed insertion scatter) sums
+    exactly, so the XLA kernels on this CPU mesh land on the same bytes
+    as the Pallas kernels on real TPU and the chip golden holds exactly
+    here too."""
     p = create_polisher(str(data_dir / "sample_reads.fastq.gz"),
                         str(data_dir / "sample_overlaps.paf.gz"),
                         str(data_dir / "sample_layout.fasta.gz"),
@@ -94,7 +95,7 @@ def test_device_consensus_quality(data_dir):
     # the quality must come from the device path, not CPU fallback
     assert engine.stats["device_windows"] > 90, engine.stats
     d = rc_distance_to_reference(data_dir, polished)
-    assert d == 1351  # device golden (real TPU == CPU-mesh XLA)
+    assert d == 1346  # device golden (real TPU == CPU-mesh XLA)
 
 
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
@@ -167,7 +168,7 @@ def test_device_consensus_banded(data_dir):
     """-b banded approximation through the device engine: half the
     alignment band for speed at a quality cost, like banded cudapoa
     (reference banded golden degrades to 4168 from 1385 full-band,
-    ``test/racon_test.cpp:400``). Recorded: 3182 (bit-reproducible
+    ``test/racon_test.cpp:400``). Recorded: 3180 (bit-reproducible
     across XLA-on-CPU-mesh and Pallas-on-TPU, like the full-band
     golden)."""
     p = create_polisher(str(data_dir / "sample_reads.fastq.gz"),
@@ -179,4 +180,4 @@ def test_device_consensus_banded(data_dir):
     (polished,) = p.polish(True)
     assert p.consensus.stats["device_windows"] > 90
     d = rc_distance_to_reference(data_dir, polished)
-    assert d == 3182  # banded device golden
+    assert d == 3180  # banded device golden

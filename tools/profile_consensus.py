@@ -61,9 +61,12 @@ def build_scale_windows(mbp):
         win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * 500)
         for _ in range(30):
             layer = truth.copy()
-            flips = rng.random(500) < 0.12
+            flips = rng.random(500) < 0.08
             layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
             layer = np.delete(layer, rng.integers(0, len(layer), 12))
+            ins_at = rng.integers(0, len(layer), 12)
+            layer = np.insert(layer, ins_at,
+                              bases[rng.integers(0, 4, 12)])
             win.add_layer(layer.tobytes(), b"9" * len(layer), 0, 499)
         windows.append(win)
     return windows
@@ -90,7 +93,7 @@ def main():
     from racon_tpu.ops import poa as poa_mod
     from racon_tpu.ops.poa import (
         GROW, K_INS, CH, DEL, Q_PAD, T_PAD, TpuPoaConsensus, _Work,
-        _consensus_kernel, _scatter_votes, _vote_from_ops, refine_round)
+        _consensus_kernel, _accumulate_votes, _vote_from_ops, refine_round)
     from racon_tpu.core.backends import CpuPoaConsensus
 
     print(f"devices: {jax.devices()}  fwd_p={pallas_nw.FWD_P_CAP} "
@@ -186,12 +189,12 @@ def main():
         print(f"walk+vote: {t_walk * 1e3:8.2f} ms", flush=True)
 
         okp = (fi == 0) & (fj == 0) & (score < (band // 2))
-        VOT = Lb * (1 + K_INS) * CH
-        sc = jax.jit(lambda idx, w8, okp, win_of: _scatter_votes(
-            idx, w8, okp, win_of, n_windows=nWp, VOT=VOT))
+        sc = jax.jit(lambda idx, w8, okp, win_of: _accumulate_votes(
+            idx, w8.astype(jnp.int32), okp, win_of, m_, bg,
+            n_windows=nWp, L=Lb, K=K_INS, band=band))
         t_scatter = timeit_pipelined(lambda: sc(idx, w8, okp, win_of))
-        print(f"scatter:   {t_scatter * 1e3:8.2f} ms", flush=True)
-        weighted, unweighted = sc(idx, w8, okp, win_of)
+        print(f"accum:     {t_scatter * 1e3:8.2f} ms", flush=True)
+        weighted, unweighted, _ = sc(idx, w8, okp, win_of)
     else:
         from racon_tpu.ops.nw import _nw_wavefront_kernel, _walk_ops_kernel
         fwd = lambda: _nw_wavefront_kernel(qrp, tp, n_, m_, max_len=Lq,
@@ -203,12 +206,17 @@ def main():
         ops, fi, fj = jax.block_until_ready(wk())
         t_walk = timeit_pipelined(wk)
         print(f"walk:      {t_walk * 1e3:8.2f} ms", flush=True)
-        vt = lambda: _vote_from_ops(
-            ops, fi, fj, score, n_, m_, qcodes, qweights, bg, win_of,
-            n_windows=nWp, max_len=Lq, band=band, L=Lb, K=K_INS)
+        def vt():
+            idx, wv, okp = _vote_from_ops(
+                ops, fi, fj, score, n_, m_, qcodes, qweights, bg,
+                max_len=Lq, band=band, L=Lb, K=K_INS)
+            w_, u_, _ = _accumulate_votes(idx, wv, okp, win_of, m_, bg,
+                                          n_windows=nWp, L=Lb, K=K_INS,
+                                          band=band)
+            return w_, u_, okp
         weighted, unweighted, okp = jax.block_until_ready(vt())
         t_scatter = timeit_pipelined(vt)
-        print(f"vote+scat: {t_scatter * 1e3:8.2f} ms", flush=True)
+        print(f"vote+accum:{t_scatter * 1e3:8.2f} ms", flush=True)
 
     ck = jax.jit(lambda w, u: _consensus_kernel(
         w, u, bcodes, bweights, blen,
