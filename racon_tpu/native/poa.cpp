@@ -22,6 +22,97 @@
 #include <thread>
 #include <vector>
 
+#if defined(__AVX512F__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+// row[j] = max(row[j], row[j-1] + gap) for j in [1, n] — the serial
+// dependence that blocks autovectorization of the NW row update (spoa
+// solves the same recurrence with its SIMD lazy-F loop). Equivalent
+// closed form: row[j] = max_{0 <= k <= j} row[k] + (j-k)*gap, an
+// inclusive max-plus prefix scan — computed per 16-lane block with
+// log2(16) shifted maxes plus one cross-block carry, so results are
+// bit-identical to the scalar loop (max is order-independent and the
+// added offsets are exact).
+inline void row_gap_scan(int32_t* row, int64_t n, int32_t gap) {
+#if defined(__AVX512F__)
+    if (n >= 32) {
+        const int32_t kNeg = INT32_MIN / 2;
+        const __m512i vneg = _mm512_set1_epi32(kNeg);
+        const __m512i g1 = _mm512_set1_epi32(gap);
+        const __m512i g2 = _mm512_set1_epi32(2 * gap);
+        const __m512i g4 = _mm512_set1_epi32(4 * gap);
+        const __m512i g8 = _mm512_set1_epi32(8 * gap);
+        alignas(64) int32_t ramp_arr[16];
+        for (int k = 0; k < 16; ++k) ramp_arr[k] = (k + 1) * gap;
+        const __m512i ramp = _mm512_load_si512(ramp_arr);
+        int32_t carry = row[0];
+        int64_t j = 1;
+        for (; j + 16 <= n + 1; j += 16) {
+            __m512i v = _mm512_loadu_si512(row + j);
+            // in-block inclusive scan: lane l takes max over lanes <= l
+            // with the matching gap multiples (alignr pulls lane l-s,
+            // shifting in -inf at the left edge)
+            __m512i s;
+            s = _mm512_alignr_epi32(v, vneg, 15);
+            v = _mm512_max_epi32(v, _mm512_add_epi32(s, g1));
+            s = _mm512_alignr_epi32(v, vneg, 14);
+            v = _mm512_max_epi32(v, _mm512_add_epi32(s, g2));
+            s = _mm512_alignr_epi32(v, vneg, 12);
+            v = _mm512_max_epi32(v, _mm512_add_epi32(s, g4));
+            s = _mm512_alignr_epi32(v, vneg, 8);
+            v = _mm512_max_epi32(v, _mm512_add_epi32(s, g8));
+            // fold in the carry from everything before this block
+            v = _mm512_max_epi32(
+                v, _mm512_add_epi32(_mm512_set1_epi32(carry), ramp));
+            _mm512_storeu_si512(row + j, v);
+            carry = row[j + 15];
+        }
+        for (; j <= n; ++j) {
+            int32_t c = row[j - 1] + gap;
+            if (c > row[j]) row[j] = c;
+        }
+        return;
+    }
+#endif
+    for (int64_t j = 1; j <= n; ++j) {
+        int32_t c = row[j - 1] + gap;
+        if (c > row[j]) row[j] = c;
+    }
+}
+
+// One predecessor's contribution to an NW row:
+//   row[j] (=|max=) max(pr[j-1] + prof[j-1], pr[j] + gap),  j in [1, n]
+// (diagonal + consume-query candidates; the in-row gap recurrence is
+// handled afterwards by row_gap_scan). FIRST overwrites, else folds max.
+template <bool FIRST>
+inline void row_update_pred(int32_t* row, const int32_t* pr,
+                            const int32_t* prof, int64_t n, int32_t gap) {
+    int64_t j = 1;
+#if defined(__AVX512F__)
+    const __m512i vg = _mm512_set1_epi32(gap);
+    for (; j + 16 <= n + 1; j += 16) {
+        __m512i diag = _mm512_add_epi32(
+            _mm512_loadu_si512(pr + j - 1),
+            _mm512_loadu_si512(prof + j - 1));
+        __m512i up = _mm512_add_epi32(_mm512_loadu_si512(pr + j), vg);
+        __m512i v = _mm512_max_epi32(diag, up);
+        if (!FIRST) v = _mm512_max_epi32(v, _mm512_loadu_si512(row + j));
+        _mm512_storeu_si512(row + j, v);
+    }
+#endif
+    for (; j <= n; ++j) {
+        int32_t a = pr[j - 1] + prof[j - 1];
+        int32_t b = pr[j] + gap;
+        int32_t c = a > b ? a : b;
+        if (FIRST || c > row[j]) row[j] = c;
+    }
+}
+
+}  // namespace
+
 namespace {
 
 constexpr int64_t kNegInf = -(1ll << 60);
@@ -380,25 +471,13 @@ struct PoaAligner {
 
             const int32_t* pr = &H[(int64_t)pred_rows[0] * stride];
             row[0] = pr[0] + gap;
-            for (int64_t j = 1; j <= n; ++j) {
-                int32_t a = pr[j - 1] + prof[j - 1];
-                int32_t b = pr[j] + gap;
-                row[j] = a > b ? a : b;
-            }
+            row_update_pred<true>(row, pr, prof, n, gap);
             for (size_t pi = 1; pi < pred_rows.size(); ++pi) {
                 pr = &H[(int64_t)pred_rows[pi] * stride];
                 if (pr[0] + gap > row[0]) row[0] = pr[0] + gap;
-                for (int64_t j = 1; j <= n; ++j) {
-                    int32_t a = pr[j - 1] + prof[j - 1];
-                    int32_t b = pr[j] + gap;
-                    int32_t c = a > b ? a : b;
-                    if (c > row[j]) row[j] = c;
-                }
+                row_update_pred<false>(row, pr, prof, n, gap);
             }
-            for (int64_t j = 1; j <= n; ++j) {
-                int32_t c = row[j - 1] + gap;
-                if (c > row[j]) row[j] = c;
-            }
+            row_gap_scan(row, n, gap);
         }
 
         // Best end node (no out-edges) at the last column; first rank wins.

@@ -526,7 +526,14 @@ class TpuAligner(PallasDispatchMixin):
         hist = np.bincount(qcat, minlength=256)
         hist += np.bincount(tcat, minlength=256)
         alphabet = np.flatnonzero(hist[1:]) + 1  # O(N), no sort; 0 is pad
-        nd, md = jnp.asarray(n), jnp.asarray(m)
+        # multi-host: every process packs the (deterministic) chunk and
+        # materializes only its addressable shards of the global arrays
+        # (the flat char blocks shard evenly too: B is a mesh multiple,
+        # so [B * max_len] splits on row boundaries)
+        from ..parallel import to_global
+        put = ((lambda a: to_global(self.mesh, a)) if self.mesh is not None
+               else jnp.asarray)
+        nd, md = put(n), put(m)
         if len(alphabet) <= 15:
             lut = np.zeros(256, np.uint8)
             lut[alphabet] = np.arange(1, len(alphabet) + 1, dtype=np.uint8)
@@ -534,11 +541,11 @@ class TpuAligner(PallasDispatchMixin):
             t4 = lut[tcat]
             q4 = q4[0::2] | (q4[1::2] << 4)
             t4 = t4[0::2] | (t4[1::2] << 4)
-            qrp, tp = _build_rows_packed(jnp.asarray(q4), jnp.asarray(t4),
+            qrp, tp = _build_rows_packed(put(q4), put(t4),
                                          nd, md, max_len=max_len,
                                          band=band)
         else:
-            qrp, tp = _build_rows(jnp.asarray(qcat), jnp.asarray(tcat),
+            qrp, tp = _build_rows(put(qcat), put(tcat),
                                   nd, md, max_len=max_len, band=band)
         args = (qrp, tp, nd, md)
         shape_key = (max_len, band, steps, B)
@@ -562,7 +569,8 @@ class TpuAligner(PallasDispatchMixin):
 
     def _finish_chunk(self, launched, band, cigars, reject):
         chunk, pairs, n, m, out = launched
-        ops_packed, score, fi, fj = jax.device_get(out)
+        from ..parallel import fetch_global
+        ops_packed, score, fi, fj = fetch_global(list(out))
         # unpack 4 codes/byte -> [B, 2L] uint8
         shifts = np.array([0, 2, 4, 6], dtype=np.uint8)
         ops = ((ops_packed[:, :, None] >> shifts) & 3).reshape(

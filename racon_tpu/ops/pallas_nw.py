@@ -561,8 +561,8 @@ def pallas_ok() -> bool:
                     jnp.asarray(sx), args[2], args[3], qcodes, qweights,
                     bg, max_len=max_len, band=band, L=L, K=K)
                 wx, ux, _ovx = _accumulate_votes(
-                    idxx, wx8, okx, win_of, args[3], bg, n_windows=nW,
-                    L=L, K=K, band=band)
+                    idxx, wx8, okx, win_of, args[3], bg, args[2],
+                    jnp.asarray(sx), n_windows=nW, L=L, K=K, band=band)
                 idx, w8, fiv, fjv = pallas_walk_vote(
                     jnp.asarray(dp), args[2], args[3], bg, qcodes,
                     qweights, band=band, L=L, K=K, CH=CH, DEL=DEL)
@@ -570,7 +570,8 @@ def pallas_ok() -> bool:
                        & (jnp.asarray(sp) < (band // 2)))
                 wp, up, _ovp = _accumulate_votes(
                     idx, w8.astype(jnp.int32), okv, win_of, args[3], bg,
-                    n_windows=nW, L=L, K=K, band=band)
+                    args[2], jnp.asarray(sp), n_windows=nW, L=L, K=K,
+                    band=band)
                 ok = (np.array_equal(np.asarray(wx), np.asarray(wp))
                       and np.array_equal(np.asarray(ux), np.asarray(up)))
             _PALLAS_OK = ok

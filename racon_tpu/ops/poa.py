@@ -224,8 +224,10 @@ def _shift_rows_left(x, amount, max_amount: int):
     return x
 
 
-def _accumulate_votes(idx, w, ok, win_of, span_m, bg, *, n_windows: int,
-                      L: int, K: int, band: int):
+def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
+                      n_windows: int, L: int, K: int, band: int,
+                      scores=(DEFAULT_MATCH, DEFAULT_MISMATCH,
+                              DEFAULT_GAP)):
     """Accumulate the per-step vote stream into per-window matrices —
     shared by both walk backends (identical results by construction).
 
@@ -246,7 +248,22 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, *, n_windows: int,
       packed into one u32 cell — counts are bounded by the layer depth
       (drop-collapse rule), so the fields cannot carry into each other.
 
-    Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted i32).
+    **Score-weighted voting** (the -m/-x/-g contract, the analog of
+    cudapoa consuming the CLI scores directly,
+    ``src/cuda/cudabatch.cpp:54-62``): every layer's votes are scaled by
+    alpha = 64 * (its alignment score under the CLI m/x/g) / (its score
+    under the reference defaults 3/-5/-4), so relatively poor layers
+    under the chosen scoring lose voting power. The match/mismatch/gap
+    counts come from the edit score plus a gap count derived from the
+    vote stream itself (gaps = insertion votes + DEL column votes), so
+    both walk backends compute identical alphas. At the default scores
+    alpha == 64 exactly for every layer — a uniform scale that cancels
+    in every consensus ratio — so default results are bit-identical to
+    unweighted voting (backbone votes are pre-scaled by 64 at pack
+    time to keep the competition fair).
+
+    Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted i32,
+    ins_overflow telemetry).
     """
     B, S = idx.shape
     VOT = L * (1 + K) * CH
@@ -254,6 +271,27 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, *, n_windows: int,
 
     col_flag = idx < L * CH
     ins_flag = (idx >= L * CH) & (idx < VOT)
+
+    # ---- per-layer score weight alpha (q6 fixed point, 64 == 1.0)
+    ms, xs, gs = scores
+    ch_all = idx & (CH - 1)
+    gaps = jnp.sum((ins_flag | (col_flag & (ch_all == DEL))
+                    ).astype(jnp.int32), axis=1)
+    mis = jnp.maximum(score - gaps, 0)
+    mat = jnp.maximum((n + span_m - gaps) // 2 - mis, 0)
+    if (ms, xs, gs) == (DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP):
+        alpha = jnp.full((B,), 64, jnp.int32)
+    else:
+        s_cli = (ms * mat + xs * mis + gs * gaps).astype(jnp.float32)
+        s_def = (DEFAULT_MATCH * mat + DEFAULT_MISMATCH * mis
+                 + DEFAULT_GAP * gaps).astype(jnp.float32)
+        # floor 1 (not 0): a layer must never lose its unweighted
+        # coverage counts to down-weighting — counts stay
+        # alpha-independent; ceiling 88 keeps 93*88 in the 13-bit field
+        alpha = jnp.clip(jnp.round(
+            64.0 * jnp.maximum(s_cli, 0.0) / jnp.maximum(s_def, 1.0)
+        ).astype(jnp.int32), 1, 88)
+    w = w * alpha[:, None]
 
     # ---- column votes: compact to rank space, reverse, align, matmul
     ch = idx & (CH - 1)  # CH is a power of two
@@ -391,12 +429,14 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
 
 @functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
                                              "Lb", "K", "steps",
-                                             "use_pallas", "Lq2"))
+                                             "use_pallas", "Lq2",
+                                             "scores"))
 def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
                  bcodes, bweights, blen, covs, ever, frozen, dropped,
                  ins_theta, del_beta, *, n_windows: int, max_len: int,
                  band: int, Lb: int, K: int, steps: int = 0,
-                 use_pallas: bool = False, Lq2: int = 0):
+                 use_pallas: bool = False, Lq2: int = 0,
+                 scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
     """One fully-device-resident refinement round.
 
     Align every layer against its current backbone span, vote, pick
@@ -468,8 +508,8 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
             ops, fi, fj, score, n, m, qcodes[:, :Lq2], qweights[:, :Lq2],
             bg, max_len=Lq2, band=band, L=Lb, K=K)
     weighted, unweighted, ins_ovf = _accumulate_votes(
-        idx, wv, okp, win_of, m, bg, n_windows=n_windows, L=Lb, K=K,
-        band=band)
+        idx, wv, okp, win_of, m, bg, n, score, n_windows=n_windows,
+        L=Lb, K=K, band=band, scores=scores)
     winner, coverage, ins_winner, ins_emit, ins_cov = _consensus_kernel(
         weighted, unweighted, bcodes, bweights, blen, ins_theta, del_beta,
         L=Lb, K=K)
@@ -548,12 +588,13 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
 @functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
                                              "max_len", "band", "Lb", "K",
                                              "steps", "use_pallas",
-                                             "Lq2"))
+                                             "Lq2", "scores"))
 def refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
                 bcodes, bweights, blen, covs, ever, frozen, dropped,
                 ins_theta, del_beta, *, rounds: int, n_windows: int,
                 max_len: int, band: int, Lb: int, K: int, steps: int = 0,
-                use_pallas: bool = False, Lq2: int = 0):
+                use_pallas: bool = False, Lq2: int = 0,
+                scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
     """All refinement rounds of a group in ONE device dispatch.
 
     ``lax.fori_loop`` over :func:`refine_round` — per-round host
@@ -564,7 +605,7 @@ def refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
         return refine_round(
             n, qcodes, qweights, win_of, real, *state, ins_theta, del_beta,
             n_windows=n_windows, max_len=max_len, band=band, Lb=Lb, K=K,
-            steps=steps, use_pallas=use_pallas, Lq2=Lq2)
+            steps=steps, use_pallas=use_pallas, Lq2=Lq2, scores=scores)
 
     state = (bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped)
     return lax.fori_loop(0, rounds, body, state)
@@ -620,19 +661,20 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # ``-g -4``, so the recorded goldens are untouched. ``-m/-x`` have
         # no quality-weighted analog; flag the divergence rather than
         # silently ignoring them.
-        scale = max(abs(gap), 1) / abs(DEFAULT_GAP)
+        # indel-emission scale: gap cost *relative to the match reward*
+        # (g=-8 with m=8 makes gaps relatively cheaper than the default
+        # g=-4/m=3, not costlier), identity at the reference defaults
+        scale = ((max(abs(gap), 1) * DEFAULT_MATCH)
+                 / (abs(DEFAULT_GAP) * max(match, 1)))
         self.ins_theta = min(ins_theta * scale, 0.95)
         # cap mirrors the ins_theta cap: past it a stronger -g would make
         # column deletion effectively impossible while insertions saturate
         # at 0.95, an asymmetry users tuning -g don't expect (ADVICE r3)
         self.del_beta = min(del_beta * scale, 2.5)
-        if (match, mismatch) != (DEFAULT_MATCH, DEFAULT_MISMATCH):
-            import warnings
-            warnings.warn(
-                f"device consensus weighs votes by base quality; "
-                f"-m {match} -x {mismatch} only affect the CPU fallback "
-                f"engine (the gap penalty -g {gap} scales the device "
-                f"indel-emission thresholds)", RuntimeWarning)
+        # -m/-x/-g reach the device engine as score-weighted voting
+        # (alpha per layer, _accumulate_votes) on top of the -g emission
+        # scaling; identity at the reference defaults
+        self.scores = (match, mismatch, gap)
         # Batch count (reference -c N, cudapolisher.cpp:215-228): windows
         # are LPT-split into N groups, every group's whole refinement loop
         # is dispatched before the first result is fetched (JAX async
@@ -799,8 +841,11 @@ class TpuPoaConsensus(PallasDispatchMixin):
             bb = w.backbone
             bcodes[wi, :len(bb)] = _CODE_LUT[np.frombuffer(bb, np.uint8)]
             if w.bqual is not None:
-                bweights[wi, :len(bb)] = \
-                    np.frombuffer(w.bqual, np.uint8).astype(np.float32) - 33.0
+                # x64: layer votes carry the q6 alpha scale (64 == 1.0),
+                # so backbone votes are pre-scaled to compete at par
+                bweights[wi, :len(bb)] = 64.0 * (
+                    np.frombuffer(w.bqual, np.uint8).astype(np.float32)
+                    - 33.0)
             blen[wi] = len(bb)
 
         return (n, qcodes, qweights, win_of, real, bg, ed), \
@@ -832,14 +877,21 @@ class TpuPoaConsensus(PallasDispatchMixin):
                    for a in range(7)]
         win_np = [np.concatenate([p[1][a] for p in packs])
                   for a in range(3)]
-        static = tuple(jnp.asarray(a) for a in pair_np[:5])   # n..real
-        bg, ed = (jnp.asarray(pair_np[5]), jnp.asarray(pair_np[6]))
-        bcodes, bweights, blen = (jnp.asarray(a) for a in win_np)
-        covs = jnp.zeros((nd * nWp, Lb), jnp.int32)
-        ever = jnp.zeros(nd * nWp, bool)
-        frozen = jnp.zeros(nd * nWp, bool)
+        # single-host: plain device puts; multi-host: every process packs
+        # the (deterministic) full arrays and materializes only its
+        # addressable shards of the global array
+        from ..parallel import to_global
+        put = ((lambda a: to_global(self.mesh, a)) if self.mesh is not None
+               else jnp.asarray)
+        static = tuple(put(a) for a in pair_np[:5])   # n..real
+        bg, ed = (put(pair_np[5]), put(pair_np[6]))
+        bcodes, bweights, blen = (put(a) for a in win_np)
+        zput = (lambda a: put(np.asarray(a)))
+        covs = zput(np.zeros((nd * nWp, Lb), np.int32))
+        ever = zput(np.zeros(nd * nWp, bool))
+        frozen = zput(np.zeros(nd * nWp, bool))
         # telemetry row per shard: [dropped, sweep-truncated, ins-overflow]
-        dropped = jnp.zeros((nd, 3), jnp.int32)
+        dropped = zput(np.zeros((nd, 3), np.int32))
         state = [bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped]
         return {"shards": shards, "static": static, "state": state,
                 "nWp": nWp, "nd": nd}
@@ -873,14 +925,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 *static, *state, theta, beta, rounds=self.rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=self.band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-                Lq2=Lq2)
+                Lq2=Lq2, scores=self.scores)
         else:
             from ..parallel import sharded_refine_loop
             out = sharded_refine_loop(
                 self.mesh, static, state, theta, beta, rounds=self.rounds,
                 n_windows_local=launch["nWp"], max_len=Lq, band=self.band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-                Lq2=Lq2)
+                Lq2=Lq2, scores=self.scores)
         launch["state"] = list(out)
 
     def _finish_group(self, launch, trim: bool, results) -> None:
@@ -889,7 +941,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # fetch only what the stitch needs (bg/ed/bweights/frozen stay on
         # device — every transferred byte rides the slow tunnel)
         _, _, bcodes, _, blen, covs, ever, _, dropped = launch["state"]
-        bcodes, blen, covs, ever, dropped = jax.device_get(
+        from ..parallel import fetch_global
+        bcodes, blen, covs, ever, dropped = fetch_global(
             [bcodes, blen, covs, ever, dropped])
         self.stats["dropped_layers"] += int(dropped[:, 0].sum())
         self.stats["sweep_truncated"] += int(dropped[:, 1].sum())

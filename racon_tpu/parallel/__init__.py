@@ -32,9 +32,56 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "d"
+
+
+def distributed_init(coordinator_address: str, num_processes: int,
+                     process_id: int) -> None:
+    """Join a multi-host JAX job (idempotent): after this,
+    ``jax.devices()`` spans every host and :func:`get_mesh` builds a
+    global mesh, so the same ``shard_map`` engines scale over DCN. The
+    reference analog is single-node multi-GPU binning
+    (``src/cuda/cudapolisher.cpp:72-83``); the TPU-native story is SPMD
+    over a global mesh with per-host input packing (SURVEY §2.3)."""
+    # NOTE: must run before anything initializes the XLA backend (even
+    # jax.process_count() would), hence the flag-only idempotence guard
+    if getattr(distributed_init, "_done", False):
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    distributed_init._done = True
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def to_global(mesh: Mesh, arr_np):
+    """Build a device array sharded along AXIS over ``mesh`` from the
+    full host-side content. Every process calls this with identically
+    computed ``arr_np`` (packing is deterministic); each materializes
+    only its addressable shards, so multi-host placement needs no
+    host-to-host transfer. Single-process: a plain device put."""
+    if not is_multihost():
+        return jax.numpy.asarray(arr_np)
+    sharding = NamedSharding(mesh, P(AXIS))
+    return jax.make_array_from_callback(arr_np.shape, sharding,
+                                        lambda idx: arr_np[idx])
+
+
+def fetch_global(tree):
+    """Fetch device results to host numpy. Multi-host: an allgather
+    over DCN replicates every shard to every process, so downstream
+    decode (stitching windows into contigs) is identical on all hosts
+    and each can emit the full output."""
+    if not is_multihost():
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+    return [np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            for x in tree]
 
 
 def get_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
@@ -100,7 +147,7 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int,
 @functools.lru_cache(maxsize=None)
 def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
                        max_len: int, band: int, Lb: int, K: int,
-                       steps: int, use_pallas: bool, Lq2: int):
+                       steps: int, use_pallas: bool, Lq2: int, scores):
     from ..ops.poa import refine_loop
 
     def local(n, qcodes, qweights, win_of, real, bg, ed,
@@ -111,7 +158,7 @@ def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
                            dropped, ins_theta, del_beta, rounds=rounds,
                            n_windows=n_windows_local, max_len=max_len,
                            band=band, Lb=Lb, K=K, steps=steps,
-                           use_pallas=use_pallas, Lq2=Lq2)
+                           use_pallas=use_pallas, Lq2=Lq2, scores=scores)
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(
@@ -122,7 +169,8 @@ def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
 def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
                         rounds: int, n_windows_local: int, max_len: int,
                         band: int, Lb: int, K: int, steps: int = 0,
-                        use_pallas: bool = False, Lq2: int = 0):
+                        use_pallas: bool = False, Lq2: int = 0,
+                        scores=(3, -5, -4)):
     """A group's whole refinement loop over a co-sharded batch, one
     dispatch (the shard-local body is ``refine_loop``'s fori over
     ``refine_round``).
@@ -139,5 +187,5 @@ def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
     the updated ``state`` stacked the same way.
     """
     fn = _sharded_refine_fn(mesh, rounds, n_windows_local, max_len, band,
-                            Lb, K, steps, use_pallas, Lq2)
+                            Lb, K, steps, use_pallas, Lq2, scores)
     return fn(*static, *state, ins_theta, del_beta)

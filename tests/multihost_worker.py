@@ -1,0 +1,70 @@
+"""Worker process for the 2-process CPU-mesh test (test_multihost.py).
+
+Each process owns 4 virtual CPU devices; after ``distributed_init`` the
+global mesh spans 8 devices across both processes and the consensus
+engine's ``shard_map`` path runs SPMD over it — the DCN analog of the
+reference's multi-GPU batch binning (``src/cuda/cudapolisher.cpp:72-83``).
+Asserts the multi-host consensus bytes equal a single-device run.
+"""
+import os
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    from racon_tpu.parallel import distributed_init, get_mesh, is_multihost
+
+    distributed_init(f"localhost:{port}", nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+    assert jax.local_device_count() == 4
+    assert is_multihost()
+
+    from __graft_entry__ import _tiny_windows
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    mesh = get_mesh()  # global: 8 devices over 2 processes
+    windows = _tiny_windows(8)
+    eng = TpuPoaConsensus(3, -5, -4, mesh=mesh, band=64, rounds=2)
+    flags = eng.run(windows, trim=False)
+    assert all(flags), flags
+    assert eng.stats["device_windows"] == len(windows), eng.stats
+    multi = [w.consensus for w in windows]
+
+    ref_windows = _tiny_windows(8)
+    ref = TpuPoaConsensus(3, -5, -4, mesh=None, band=64, rounds=2)
+    ref.run(ref_windows, trim=False)
+    single = [w.consensus for w in ref_windows]
+    assert multi == single, "multi-host consensus differs from single-device"
+
+    # sharded aligner across both processes, vs the single-device CIGARs
+    import numpy as np
+    from racon_tpu.ops.nw import TpuAligner
+
+    rng = np.random.default_rng(9)
+    bases = b"ACGT"
+    pairs = []
+    for _ in range(16):
+        t = bytes(bases[i] for i in rng.integers(0, 4, 120))
+        q = bytearray(t)
+        for p in rng.integers(1, 119, 8):
+            q[p] = bases[int(rng.integers(0, 4))]
+        pairs.append((bytes(q), t))
+    multi_cig = TpuAligner(mesh=mesh, buckets=((256, 128),)).align_batch(
+        pairs)
+    single_cig = TpuAligner(mesh=None, buckets=((256, 128),)).align_batch(
+        pairs)
+    assert multi_cig == single_cig, "multi-host CIGARs differ"
+    print(f"multihost worker {pid}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -147,3 +147,55 @@ def test_fragment_correction_device_backend(data_dir, tmp_path):
     cpu_total = sum(len(s.data) for s in cpu_out)
     dev_total = sum(len(s.data) for s in dev_out)
     assert abs(dev_total - cpu_total) <= 0.05 * cpu_total
+
+
+def correct_device(data_dir, reads, overlaps, type_, drop):
+    """Full-set fragment correction through BOTH device engines (tpu
+    aligner + tpu consensus), like the reference's GPU correction tests
+    (racon_test.cpp:424-496)."""
+    p = create_polisher(
+        str(data_dir / reads), str(data_dir / overlaps),
+        str(data_dir / reads), type_,
+        window_length=500, quality_threshold=10.0, error_threshold=0.3,
+        match=1, mismatch=-1, gap=-1, num_threads=8,
+        consensus_backend="tpu", aligner_backend="tpu")
+    p.initialize()
+    out = p.polish(drop)
+    assert p.consensus.stats["device_windows"] > 0
+    return len(out), sum(len(s.data) for s in out)
+
+
+@slow
+def test_fragment_correction_device_kc_ava(data_dir):
+    n, total = correct_device(data_dir, "sample_reads.fastq.gz",
+                              "sample_ava_overlaps.paf.gz",
+                              PolisherType.C, True)
+    assert n == 39           # reference CUDA: 39 / 385,543
+    assert total == 390039   # device golden (our CPU: 389,342)
+
+
+@slow
+def test_fragment_correction_device_kf_paf_q(data_dir):
+    n, total = correct_device(data_dir, "sample_reads.fastq.gz",
+                              "sample_ava_overlaps.paf.gz",
+                              PolisherType.F, False)
+    assert n == 236          # reference CUDA: 236 / 1,655,505
+    assert total == 1656553  # device golden (our CPU: 1,658,842)
+
+
+@slow
+def test_fragment_correction_device_kf_paf_no_q(data_dir):
+    n, total = correct_device(data_dir, "sample_reads.fasta.gz",
+                              "sample_ava_overlaps.paf.gz",
+                              PolisherType.F, False)
+    assert n == 236          # reference CUDA: 236 / 1,663,732
+    assert total == 1652942  # device golden (our CPU: 1,664,206)
+
+
+@slow
+def test_fragment_correction_device_kf_mhap(data_dir):
+    n, total = correct_device(data_dir, "sample_reads.fastq.gz",
+                              "sample_ava_overlaps.mhap.gz",
+                              PolisherType.F, False)
+    assert n == 236          # identical to PAF+qualities, as upstream
+    assert total == 1656553  # device golden

@@ -70,20 +70,62 @@ def test_banded_consensus_still_polishes():
 
 def test_device_scores_map_to_emission_thresholds():
     """-g scales the device indel-emission thresholds (identity at the
-    default -4, so goldens are untouched); -m/-x warn that they only
-    affect the CPU fallback (cudapoa consumes the scores directly,
-    cudabatch.cpp:54-62 — the pileup engine's analog is this mapping)."""
-    import warnings
-
+    default -4, so goldens are untouched; the scale is capped so extreme
+    -g degrades symmetrically, ADVICE r3); -m/-x/-g also reach the vote
+    weights as the per-layer score multiplier (cudapoa consumes the
+    scores directly, cudabatch.cpp:54-62 — score-weighted voting is the
+    pileup engine's analog)."""
     from racon_tpu.ops.poa import TpuPoaConsensus
 
     default = TpuPoaConsensus(3, -5, -4)
     assert default.ins_theta == 0.25 and default.del_beta == 0.65
+    assert default.scores == (3, -5, -4)
 
     strong_gap = TpuPoaConsensus(3, -5, -8)
     assert strong_gap.ins_theta == 0.5 and strong_gap.del_beta == 1.3
 
-    with warnings.catch_warnings(record=True) as wlist:
-        warnings.simplefilter("always")
-        TpuPoaConsensus(5, -4, -4)
-    assert any("CPU fallback" in str(w.message) for w in wlist)
+    extreme_gap = TpuPoaConsensus(3, -5, -20)
+    assert extreme_gap.ins_theta == 0.95 and extreme_gap.del_beta == 2.5
+
+    ref_e2e = TpuPoaConsensus(8, -6, -8)  # ci/gpu/cuda_test.sh:29 config
+    assert ref_e2e.scores == (8, -6, -8)
+
+
+def test_device_alpha_identity_at_defaults():
+    """The score-weight alpha is exactly 64 (the q6 unit) for every layer
+    at the reference default scores — weighted voting is bit-identical to
+    unweighted there — and deviates for other score sets."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from racon_tpu.ops.poa import CH, DEL, _accumulate_votes
+
+    B, S, L, K, nW = 8, 128, 64, 4, 2
+    rng = np.random.default_rng(5)
+    # a tiny synthetic vote stream: 20 column votes + 2 ins votes per row
+    idx = np.full((B, S), L * (1 + K) * CH, np.int32)
+    for b in range(B):
+        for t in range(20):
+            ch = DEL if t % 7 == 0 else int(rng.integers(0, 4))
+            idx[b, t] = (19 - t) * CH + ch
+        idx[b, 20] = (L + 3 * K + 0) * CH + 1
+        idx[b, 21] = (L + 3 * K + 1) * CH + 2
+    w = np.where(idx < L * (1 + K) * CH, 9, 0).astype(np.int32)
+    ok = np.ones(B, bool)
+    win_of = np.zeros(B, np.int32)
+    span_m = (np.sum(idx < L * CH, axis=1)).astype(np.int32)
+    n = span_m + 2  # 2 ins steps consume query
+    score = np.full(B, 5, np.int32)
+
+    args = [jnp.asarray(a) for a in (idx, w, ok, win_of, span_m,
+                                     np.zeros(B, np.int32), n, score)]
+    w_def, u_def, _ = _accumulate_votes(
+        *args, n_windows=nW, L=L, K=K, band=64, scores=(3, -5, -4))
+    w_e2e, u_e2e, _ = _accumulate_votes(
+        *args, n_windows=nW, L=L, K=K, band=64, scores=(8, -6, -8))
+    # defaults: every weight is w * 64 exactly
+    assert float(w_def.max()) > 0
+    assert np.all(np.asarray(w_def) % 64 == 0)
+    # counts are alpha-independent; weights shift under the e2e scores
+    assert np.array_equal(np.asarray(u_def), np.asarray(u_e2e))
+    assert not np.array_equal(np.asarray(w_def), np.asarray(w_e2e))

@@ -1,0 +1,46 @@
+"""Multi-host mesh path: 2 JAX processes x 4 virtual CPU devices form one
+8-device global mesh over the coordination service; the consensus engine
+runs its sharded refinement loop SPMD across both processes (per-host
+packing via ``parallel.to_global``, result replication via
+``parallel.fetch_global``) and must produce byte-identical consensus to a
+single-device run. SURVEY §2.3's "multi-host via DCN with per-host input
+sharding"; reference analog ``src/cuda/cudapolisher.cpp:72-83``.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+RUN_SLOW = os.environ.get("RACON_TPU_SLOW", "") == "1"
+
+WORKER = pathlib.Path(__file__).parent / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_two_process_mesh():
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(pid), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out.decode(errors="replace"))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"multihost worker {pid}: OK" in out

@@ -1,0 +1,118 @@
+"""NGS window-type path: the mean-read-length heuristic and the no-trim
+consensus semantics for short accurate reads.
+
+Reference contract: windows become ``kNGS`` when the mean sequence length
+is <= 1000 (``src/polisher.cpp:275-276``) and NGS consensus skips the
+TGS coverage end-trim entirely (``src/window.cpp:115-139`` trims only for
+``WindowType::kTGS``).
+"""
+
+import numpy as np
+import pytest
+
+from racon_tpu import native
+from racon_tpu.core.polisher import create_polisher
+from racon_tpu.core.window import Window, WindowType
+from racon_tpu.models.poa import PoaAlignmentEngine
+
+
+def _write_set(tmp_path, read_len, n_reads=40, contig_len=3000, seed=3):
+    """A synthetic contig + evenly tiled reads of ``read_len`` with their
+    PAF overlaps; returns (reads, paf, layout) paths."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    truth = bases[rng.integers(0, 4, contig_len)]
+    backbone = truth.copy()
+    flips = rng.random(contig_len) < 0.04
+    backbone[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+
+    layout = tmp_path / "layout.fasta"
+    layout.write_bytes(b">ctg\n" + backbone.tobytes() + b"\n")
+
+    reads_path = tmp_path / "reads.fastq"
+    paf_path = tmp_path / "ovl.paf"
+    step = max(1, (contig_len - read_len) // n_reads)
+    with open(reads_path, "wb") as rf, open(paf_path, "wb") as pf:
+        for ri in range(n_reads):
+            start = min(ri * step, contig_len - read_len)
+            read = truth[start:start + read_len].copy()
+            flips = rng.random(read_len) < 0.02
+            read[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+            name = b"read%d" % ri
+            rf.write(b"@" + name + b"\n" + read.tobytes() + b"\n+\n"
+                     + b"I" * read_len + b"\n")
+            pf.write(b"\t".join([
+                name, b"%d" % read_len, b"0", b"%d" % read_len, b"+",
+                b"ctg", b"%d" % contig_len, b"%d" % start,
+                b"%d" % (start + read_len), b"%d" % (read_len // 2),
+                b"%d" % read_len, b"255"]) + b"\n")
+    return reads_path, paf_path, layout
+
+
+def _polisher(tmp_path, read_len, **kw):
+    reads, paf, layout = _write_set(tmp_path, read_len)
+    p = create_polisher(str(reads), str(paf), str(layout), num_threads=2,
+                        **kw)
+    p.initialize()
+    return p
+
+
+def test_heuristic_flips_to_ngs(tmp_path):
+    """Mean read length <= 1000 -> every window is NGS; > 1000 -> TGS
+    (``polisher.cpp:275-276``). The mean includes the target contig."""
+    p = _polisher(tmp_path / "short", 300)
+    assert p.windows and all(w.type == WindowType.NGS for w in p.windows)
+
+    p = _polisher(tmp_path / "long", 1400)
+    assert p.windows and all(w.type == WindowType.TGS for w in p.windows)
+
+
+def test_ngs_consensus_skips_trim():
+    """An identical window polished as NGS vs TGS: low-coverage window
+    ends must be trimmed only on the TGS path (``window.cpp:115-139``)."""
+    rng = np.random.default_rng(11)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    backbone = bases[rng.integers(0, 4, 200)]
+
+    def build(wtype):
+        win = Window(0, 0, wtype, backbone.tobytes(), b"5" * len(backbone))
+        # 6 layers covering only the middle [50, 150): ends have zero
+        # layer coverage, far below the (n-1)/2 trim threshold
+        for _ in range(6):
+            layer = backbone[50:150].copy()
+            flips = rng.random(100) < 0.02
+            layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+            win.add_layer(layer.tobytes(), b"I" * 100, 50, 149)
+        return win
+
+    engine = PoaAlignmentEngine(3, -5, -4)
+    ngs = build(WindowType.NGS)
+    ngs.generate_consensus(engine, trim=True)
+    tgs = build(WindowType.TGS)
+    tgs.generate_consensus(engine, trim=True)
+
+    # NGS keeps the full span; TGS trims the uncovered ends
+    assert len(ngs.consensus) >= 190
+    assert len(tgs.consensus) <= 110
+    assert len(tgs.consensus) >= 90
+
+
+def test_ngs_pipeline_end_to_end(tmp_path):
+    """Short-read polishing end to end: NGS windows, consensus closer to
+    the truth than the backbone (no trimming artifacts at window edges —
+    output length stays ~contig-sized)."""
+    reads, paf, layout = _write_set(tmp_path, 300)
+    p = create_polisher(str(reads), str(paf), str(layout), num_threads=2)
+    p.initialize()
+    assert all(w.type == WindowType.NGS for w in p.windows)
+    (polished,) = p.polish(True)
+
+    rng = np.random.default_rng(3)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    truth = bases[rng.integers(0, 4, 3000)].tobytes()
+    backbone_fa = (tmp_path / "layout.fasta").read_bytes().splitlines()[1]
+    d_backbone = native.edit_distance(backbone_fa, truth)
+    d_polished = native.edit_distance(polished.data, truth)
+    assert d_polished < d_backbone / 2, (d_polished, d_backbone)
+    assert len(polished.data) > 2800  # no TGS-style end trims

@@ -181,3 +181,81 @@ def test_device_consensus_banded(data_dir):
     assert p.consensus.stats["device_windows"] > 90
     d = rc_distance_to_reference(data_dir, polished)
     assert d == 3180  # banded device golden
+
+
+# ---- device-engine goldens for every scenario the reference records CUDA
+# goldens for (test/racon_test.cpp:292-422). Values recorded on real TPU
+# v5e by tools/record_goldens.py and bit-reproducible on the CPU-mesh XLA
+# kernels; the reference's own CUDA-vs-CPU divergence is the yardstick
+# (e.g. cudapoa 1385 vs spoa 1312; banded/w1000 degrade to 4168).
+
+def device_polish(data_dir, reads, overlaps, **kw):
+    p = create_polisher(str(data_dir / reads), str(data_dir / overlaps),
+                        str(data_dir / "sample_layout.fasta.gz"),
+                        num_threads=8, consensus_backend="tpu", **kw)
+    p.initialize()
+    out = p.polish(True)
+    assert p.consensus.stats["fallback_windows"] == 0
+    return out
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_fasta_paf(data_dir):
+    (polished,) = device_polish(data_dir, "sample_reads.fasta.gz",
+                                "sample_overlaps.paf.gz")
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d == 1702  # device golden (cudapoa: 1607; CPU engines: ~1566)
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_fastq_sam(data_dir):
+    (polished,) = device_polish(data_dir, "sample_reads.fastq.gz",
+                                "sample_overlaps.sam.gz")
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d == 1388  # device golden (cudapoa: 1541; our CPU: 1346)
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_fasta_sam(data_dir):
+    (polished,) = device_polish(data_dir, "sample_reads.fasta.gz",
+                                "sample_overlaps.sam.gz")
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d == 2024  # device golden (cudapoa: 1661; reference CPU: 1770)
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_w1000(data_dir):
+    (polished,) = device_polish(data_dir, "sample_reads.fastq.gz",
+                                "sample_overlaps.paf.gz",
+                                window_length=1000)
+    d = rc_distance_to_reference(data_dir, polished)
+    # wider windows cost the pileup engine accuracy the same way banded
+    # cudapoa degrades at w=1000 (reference CUDA: 4168 vs its CPU 1289)
+    assert d == 2591  # device golden
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_unit_scores(data_dir):
+    (polished,) = device_polish(data_dir, "sample_reads.fastq.gz",
+                                "sample_overlaps.paf.gz",
+                                match=1, mismatch=-1, gap=-1)
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d == 1598  # device golden (cudapoa: 1361; reference CPU: 1321)
+
+
+@pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
+def test_device_consensus_e2e_scores(data_dir):
+    """The reference's GPU-CI invocation `-m 8 -x -6 -g -8 -c 1`
+    (ci/gpu/cuda_test.sh:29) through the device engine: -m/-x/-g reach
+    the score-weighted voting and the emission thresholds — recorded
+    golden, no ignored-flag warnings."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as wlist:
+        warnings.simplefilter("always")
+        (polished,) = device_polish(data_dir, "sample_reads.fastq.gz",
+                                    "sample_overlaps.paf.gz",
+                                    match=8, mismatch=-6, gap=-8)
+    assert not [w for w in wlist if "only affect" in str(w.message)]
+    d = rc_distance_to_reference(data_dir, polished)
+    assert d == 1518  # device golden

@@ -190,7 +190,7 @@ def main():
 
         okp = (fi == 0) & (fj == 0) & (score < (band // 2))
         sc = jax.jit(lambda idx, w8, okp, win_of: _accumulate_votes(
-            idx, w8.astype(jnp.int32), okp, win_of, m_, bg,
+            idx, w8.astype(jnp.int32), okp, win_of, m_, bg, n_, score,
             n_windows=nWp, L=Lb, K=K_INS, band=band))
         t_scatter = timeit_pipelined(lambda: sc(idx, w8, okp, win_of))
         print(f"accum:     {t_scatter * 1e3:8.2f} ms", flush=True)
@@ -211,8 +211,8 @@ def main():
                 ops, fi, fj, score, n_, m_, qcodes, qweights, bg,
                 max_len=Lq, band=band, L=Lb, K=K_INS)
             w_, u_, _ = _accumulate_votes(idx, wv, okp, win_of, m_, bg,
-                                          n_windows=nWp, L=Lb, K=K_INS,
-                                          band=band)
+                                          n_, score, n_windows=nWp,
+                                          L=Lb, K=K_INS, band=band)
             return w_, u_, okp
         weighted, unweighted, okp = jax.block_until_ready(vt())
         t_scatter = timeit_pipelined(vt)
