@@ -216,67 +216,77 @@ class Overlap:
         self.cigar = None
 
     def find_breaking_points_from_cigar(self, window_length: int) -> None:
-        """Run-based re-derivation of the per-base walk at
-        ``overlap.cpp:226-292``.
+        q_off = self.q_length - self.q_end if self.strand else self.q_begin
+        self.breaking_points.extend(breaking_points_from_cigar(
+            self.cigar, q_off, self.t_begin, self.t_end, window_length))
 
-        State: (q_ptr, t_ptr) point at the last consumed base of each
-        sequence; window boundaries are target positions ``i-1`` for every
-        multiple ``i`` of ``window_length`` in ``(t_begin, t_end)`` plus
-        ``t_end-1``. Whenever the target pointer crosses a boundary the pair
-        (first match after previous boundary, last match so far) is emitted —
-        provided a match was seen since the previous boundary.
-        """
-        window_ends: List[int] = []
-        i = 0
-        while i < self.t_end:
-            if i > self.t_begin:
-                window_ends.append(i - 1)
-            i += window_length
-        window_ends.append(self.t_end - 1)
 
-        w = 0
-        found_first = False
-        first = (0, 0)
-        last = (0, 0)
-        bp = self.breaking_points
+def breaking_points_from_cigar(cigar: str, q_off: int, t_begin: int,
+                               t_end: int, window_length: int
+                               ) -> List[Tuple[int, int]]:
+    """Run-based re-derivation of the per-base walk at
+    ``overlap.cpp:226-292`` (shared by the CIGAR path and the host
+    fallback of the device breaking-points path).
 
-        q_ptr = (self.q_length - self.q_end if self.strand else self.q_begin) - 1
-        t_ptr = self.t_begin - 1
+    State: (q_ptr, t_ptr) point at the last consumed base of each
+    sequence; window boundaries are target positions ``i-1`` for every
+    multiple ``i`` of ``window_length`` in ``(t_begin, t_end)`` plus
+    ``t_end-1``. Whenever the target pointer crosses a boundary the pair
+    (first match after previous boundary, last match so far) is emitted —
+    provided a match was seen since the previous boundary.
+    """
+    window_ends: List[int] = []
+    i = 0
+    while i < t_end:
+        if i > t_begin:
+            window_ends.append(i - 1)
+        i += window_length
+    window_ends.append(t_end - 1)
 
-        for n, op in parse_cigar(self.cigar):
-            if op in ("M", "=", "X"):
-                # Match run covering t positions t_ptr+1 .. t_ptr+n.
-                run_q, run_t = q_ptr, t_ptr
-                start_k = 1  # first base index within the run after last boundary
-                while w < len(window_ends) and window_ends[w] <= run_t + n:
-                    e = window_ends[w]
-                    # invariant: earlier runs consumed all boundaries <= t_ptr
-                    assert e > run_t, "boundary behind current run"
-                    k = e - run_t  # base count consumed to reach boundary
-                    if not found_first:
-                        first = (run_t + start_k, run_q + start_k)
-                    # last match at the boundary base itself
+    w = 0
+    found_first = False
+    first = (0, 0)
+    last = (0, 0)
+    bp: List[Tuple[int, int]] = []
+
+    q_ptr = q_off - 1
+    t_ptr = t_begin - 1
+
+    for n, op in parse_cigar(cigar):
+        if op in ("M", "=", "X"):
+            # Match run covering t positions t_ptr+1 .. t_ptr+n.
+            run_q, run_t = q_ptr, t_ptr
+            start_k = 1  # first base index within the run after last boundary
+            while w < len(window_ends) and window_ends[w] <= run_t + n:
+                e = window_ends[w]
+                # invariant: earlier runs consumed all boundaries <= t_ptr
+                assert e > run_t, "boundary behind current run"
+                k = e - run_t  # base count consumed to reach boundary
+                if not found_first:
+                    first = (run_t + start_k, run_q + start_k)
+                # last match at the boundary base itself
+                bp.append(first)
+                bp.append((e + 1, run_q + k + 1))
+                found_first = False
+                start_k = k + 1
+                w += 1
+            # remaining bases of the run after the last in-run boundary
+            if start_k <= n:
+                if not found_first:
+                    found_first = True
+                    first = (run_t + start_k, run_q + start_k)
+                last = (run_t + n + 1, run_q + n + 1)
+            q_ptr += n
+            t_ptr += n
+        elif op == "I":
+            q_ptr += n
+        elif op in ("D", "N"):
+            while w < len(window_ends) and window_ends[w] <= t_ptr + n:
+                if found_first:
                     bp.append(first)
-                    bp.append((e + 1, run_q + k + 1))
-                    found_first = False
-                    start_k = k + 1
-                    w += 1
-                # remaining bases of the run after the last in-run boundary
-                if start_k <= n:
-                    if not found_first:
-                        found_first = True
-                        first = (run_t + start_k, run_q + start_k)
-                    last = (run_t + n + 1, run_q + n + 1)
-                q_ptr += n
-                t_ptr += n
-            elif op == "I":
-                q_ptr += n
-            elif op in ("D", "N"):
-                while w < len(window_ends) and window_ends[w] <= t_ptr + n:
-                    if found_first:
-                        bp.append(first)
-                        bp.append(last)
-                    found_first = False
-                    w += 1
-                t_ptr += n
-            # S/H/P consume nothing here (clips already folded into q_begin)
+                    bp.append(last)
+                found_first = False
+                w += 1
+            t_ptr += n
+        # S/H/P consume nothing here (clips already folded into q_begin)
+    return bp

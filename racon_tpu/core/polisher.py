@@ -250,24 +250,32 @@ class Polisher:
         log = self.logger
         msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
         need = [o for o in overlaps if not o.cigar and not o.breaking_points]
+        handled = set()  # resolved end-to-end on device (maybe-empty bps)
         if getattr(self.aligner, "wants_full_stream", False):
             # device backend buckets/chunks internally; hand it a large
             # slice so batches stay dense, but still bound the transient
             # span copies (2x aligned bases of duplicated host bytes if
             # unbounded — reference analog: 1 GiB streaming chunks,
-            # polisher.cpp:26)
+            # polisher.cpp:26). Breaking points come straight off the
+            # device (~8 bytes per window boundary) instead of CIGARs
+            # (~2 bits per base) — the host link's bandwidth, not the DP,
+            # bounded the aligner.
             chunk = 65536
             for begin in range(0, len(need), chunk):
                 part = need[begin:begin + chunk]
                 pairs = [(o.query_span_bytes(self.sequences),
                           o.target_span_bytes(self.sequences)) for o in part]
+                metas = [(o.t_begin,
+                          o.q_length - o.q_end if o.strand else o.q_begin)
+                         for o in part]
                 base = begin
-                cigars = self.aligner.align_batch(
-                    pairs,
+                bps = self.aligner.breaking_points_batch(
+                    pairs, metas, self.window_length,
                     progress=lambda d, t: log.bar_to(msg, base + d,
                                                      len(need)))
-                for o, cigar in zip(part, cigars):
-                    o.cigar = cigar
+                for o, bp in zip(part, bps):
+                    o.breaking_points = bp
+                    handled.add(id(o))
         else:
             # host path: bounded chunks keep transient span copies O(chunk)
             # rather than O(total reads) (reference analog: 1 GiB streaming
@@ -282,7 +290,8 @@ class Polisher:
                     o.cigar = cigar
                 log.bar_to(msg, begin + len(part), len(need))
         for o in overlaps:
-            o.find_breaking_points(self.sequences, self.window_length)
+            if id(o) not in handled:
+                o.find_breaking_points(self.sequences, self.window_length)
         self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
 
     def _build_windows(self, overlaps: List[Overlap]) -> None:

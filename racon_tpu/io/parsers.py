@@ -1,8 +1,11 @@
 """Streaming FASTA/FASTQ/MHAP/PAF/SAM parsers with transparent gzip.
 
 Role-equivalent of the reference's vendored ``bioparser`` library (used via
-``bioparser::createParser`` at ``src/polisher.cpp:83-133``). Matches its
-observable behaviour:
+``bioparser::createParser`` at ``src/polisher.cpp:83-133``). FASTA/FASTQ
+ingest runs through the native zlib parser when the C++ core is built
+(``native/parsers.cpp``, >100 MB/s; the Python loops below are the
+fallback and the behavioural oracle — ``tests/test_parsers.py`` asserts
+record-for-record equality). Matches bioparser's observable behaviour:
 
 - names are truncated at the first whitespace character;
 - FASTA/FASTQ records may span multiple lines;
@@ -53,7 +56,22 @@ def _first_token(line: bytes) -> bytes:
     return line.split(None, 1)[0] if line else b""
 
 
+def _native_records(path: str, is_fastq: bool):
+    from .. import native
+    if not native.available():
+        return None
+    try:
+        recs = native.parse_seqfile(path, is_fastq)
+    except native.NativeBuildError:
+        return None
+    return [SequenceRecord(n, d, q) for n, d, q in recs]
+
+
 def parse_fasta(path: str) -> Iterator[SequenceRecord]:
+    recs = _native_records(path, False)
+    if recs is not None:
+        yield from recs
+        return
     name = None
     chunks: list = []
     with open_maybe_gzip(path) as f:
@@ -75,6 +93,10 @@ def parse_fasta(path: str) -> Iterator[SequenceRecord]:
 def parse_fastq(path: str) -> Iterator[SequenceRecord]:
     """Multi-line-tolerant FASTQ: sequence lines until '+', then quality bytes
     until their length matches the sequence length."""
+    recs = _native_records(path, True)
+    if recs is not None:
+        yield from recs
+        return
     with open_maybe_gzip(path) as f:
         it = iter(f)
         for raw in it:

@@ -38,7 +38,7 @@ def build(force: bool = False) -> pathlib.Path:
                 "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                 "-march=native", "-pthread",
                 *[str(s) for s in _SOURCES],
-                "-o", str(_LIB_PATH),
+                "-o", str(_LIB_PATH), "-lz",
             ]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
@@ -86,6 +86,11 @@ def load():
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8)]
     lib.rt_free.restype = None
     lib.rt_free.argtypes = [ctypes.c_void_p]
+    lib.rt_parse_seqfile.restype = ctypes.c_int64
+    lib.rt_parse_seqfile.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_char_p]
     _lib = lib
     return _lib
 
@@ -199,3 +204,35 @@ def nw_cigar_batch(pairs, num_threads: int = 1) -> list:
         result.append(ctypes.string_at(outs[i]).decode())
         lib.rt_free(outs[i])
     return result
+
+
+def parse_seqfile(path: str, is_fastq: bool):
+    """Parse a (possibly gzipped) FASTA/FASTQ file natively; returns a
+    list of (name, data, quality|None) byte tuples. Raises ValueError on
+    malformed input (same conditions as the Python parsers)."""
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    blob = ctypes.c_void_p()
+    offs = ctypes.c_void_p()
+    err = ctypes.create_string_buffer(256)
+    n = lib.rt_parse_seqfile(path.encode(), 1 if is_fastq else 0,
+                             ctypes.byref(blob), ctypes.byref(offs), err)
+    if n < 0:
+        raise ValueError(err.value.decode(errors="replace"))
+    try:
+        o = (ctypes.c_int64 * (6 * n)).from_address(offs.value) if n else []
+        base = blob.value
+        out = []
+        for i in range(n):
+            no, nl, so, sl, qo, ql = o[6 * i: 6 * i + 6]
+            out.append((
+                ctypes.string_at(base + no, nl),
+                ctypes.string_at(base + so, sl),
+                ctypes.string_at(base + qo, ql) if qo >= 0 else None,
+            ))
+        return out
+    finally:
+        if n >= 0:
+            lib.rt_free(blob)
+            lib.rt_free(offs)

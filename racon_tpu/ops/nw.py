@@ -313,6 +313,61 @@ def _sweep_bound(max_nm: int, max_len: int) -> int:
     return -(-steps // 128) * 128
 
 
+@functools.partial(jax.jit, static_argnames=("w", "NW"))
+def _breaking_points_kernel(ops_packed, n, m, first_rel, nb, *, w: int,
+                            NW: int):
+    """Per-window breaking points straight from the packed walk op codes —
+    the device analog of :func:`core.overlap.breaking_points_from_cigar`,
+    so only ~8 bytes per window boundary ever cross the host link instead
+    of the whole op stream (~2 bits/base; the tunnel's bandwidth, not the
+    DP, bounded the aligner).
+
+    Coordinates are span-relative and packed ``tpos << 14 | qpos`` (both
+    < 16384, the bucket cap). For boundary interval k (boundaries at
+    ``first_rel + j*w`` for j < nb-1, plus ``m-1``):
+
+    - ``bp_first[b, k]`` = packed coords of the first match in interval k
+      (BIG when the interval has no match — nothing is emitted, exactly
+      the walker's found_first rule);
+    - ``bp_last[b, k]`` = packed coords of the last match at or before
+      boundary k (a running prefix max; the walker's ``last``/M-crossing
+      cases unify to this).
+
+    Identical for both walk backends: gap-code placement differs but the
+    M steps' (tpos, qpos) sets are equal and min/max are order-free.
+    """
+    B, S4 = ops_packed.shape
+    S = S4 * 4
+    shifts = jnp.arange(4, dtype=jnp.uint8) * 2
+    ops = ((ops_packed[:, :, None] >> shifts) & 3).reshape(B, S)
+    is_real = ops < 3
+    is_M = ops == 0
+    di = (is_M | (ops == 1)).astype(jnp.int32)
+    dj = (is_M | (ops == 2)).astype(jnp.int32)
+    i_t = n[:, None] - jnp.cumsum(di, axis=1) + di
+    j_t = m[:, None] - jnp.cumsum(dj, axis=1) + dj
+    tpos = j_t - 1          # 0-based span-relative target pos of an M base
+    qpos = i_t - 1
+    BIG = jnp.int32(1 << 30)
+
+    # boundary-interval index: number of boundaries < tpos (the final
+    # boundary m-1 is never < tpos since tpos <= m-1)
+    widx = jnp.clip(
+        -(-(tpos - first_rel[:, None]) // w), 0, nb[:, None] - 1)
+    valid = is_M & is_real & (tpos >= 0)
+    packed = jnp.where(valid, (tpos << 14) | jnp.maximum(qpos, 0), BIG)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    flat = jnp.where(valid, rows * NW + widx, B * NW)
+
+    bp_first = jnp.full(B * NW + 1, BIG, jnp.int32).at[
+        flat.reshape(-1)].min(packed.reshape(-1))[:B * NW].reshape(B, NW)
+    bp_last = jnp.full(B * NW + 1, -1, jnp.int32).at[
+        flat.reshape(-1)].max(jnp.where(valid, packed, -1).reshape(-1)
+                              )[:B * NW].reshape(B, NW)
+    bp_last = lax.cummax(bp_last, axis=1)
+    return bp_first, bp_last
+
+
 def _ops_to_cigar(path: np.ndarray) -> str:
     """Run-length encode a backward-order op path into a CIGAR string
     (callers pre-filter ``ops < 3`` — the Pallas walk interleaves
@@ -384,17 +439,39 @@ class TpuAligner(PallasDispatchMixin):
 
     def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]],
                     progress=None) -> List[str]:
-        # progress counts pairs whose final CIGAR is settled — escaped pairs
-        # re-enter a wider bucket and are only counted once, on their last
-        # visit; fallback/empty pairs are counted when resolved
+        """CIGAR strings for every pair (test/bench surface; the pipeline
+        uses :meth:`breaking_points_batch`, which never fetches the op
+        stream)."""
+        return self._drive(pairs, progress, None)
+
+    def breaking_points_batch(self, pairs, metas, window_length: int,
+                              progress=None):
+        """Per-window breaking points for every (query-span, target-span)
+        pair — the production surface behind
+        ``Polisher.find_overlap_breaking_points``. ``metas[i]`` is the
+        overlap's ``(t_begin, q_off)`` (global target start; strand-aware
+        global query offset). The walk stays on device and only ~8 bytes
+        per window boundary are fetched (:func:`_breaking_points_kernel`);
+        rejects fall back to the host aligner + the shared CIGAR walker,
+        and every path returns pairs identical to the walker's."""
+        return self._drive(pairs, progress, (window_length, metas))
+
+    def _drive(self, pairs, progress, bp_meta):
+        # progress counts pairs whose final result is settled — escaped
+        # pairs re-enter a wider bucket and are only counted once, on
+        # their last visit; fallback/empty pairs are counted when resolved
         done_pairs = 0
-        cigars: List[str] = [""] * len(pairs)
+        cigars: List = [("" if bp_meta is None else [])
+                        for _ in range(len(pairs))]
         by_bucket = {}
         reject: List[int] = []
         for idx, (q, t) in enumerate(pairs):
             if len(q) == 0 or len(t) == 0:
-                cigars[idx] = (f"{len(t)}D" if len(t) else
-                               (f"{len(q)}I" if len(q) else ""))
+                if bp_meta is None:
+                    cigars[idx] = (f"{len(t)}D" if len(t) else
+                                   (f"{len(q)}I" if len(q) else ""))
+                else:
+                    cigars[idx] = []  # no matches -> no breaking points
                 done_pairs += 1
                 continue
             bi = self._bucket_index(len(q), len(t))
@@ -452,12 +529,14 @@ class TpuAligner(PallasDispatchMixin):
                     chunk = indices[start:start + batch_cap]
                     inflight.append(
                         (band, esc, self._launch_chunk(pairs, chunk,
-                                                       max_len, band)))
+                                                       max_len, band,
+                                                       bp_meta)))
                     if len(inflight) >= self.num_batches:
                         band0, esc0, launched = inflight.pop(0)
                         n_chunk = len(launched[0])
                         n_esc = len(esc0)
-                        self._finish_chunk(launched, band0, cigars, esc0)
+                        self._finish_chunk(launched, band0, cigars, esc0,
+                                           bp_meta)
                         done_pairs += n_chunk - (len(esc0) - n_esc)
                         if progress is not None:
                             progress(done_pairs, len(pairs))
@@ -465,7 +544,7 @@ class TpuAligner(PallasDispatchMixin):
                 band0, esc0, launched = inflight.pop(0)
                 n_chunk = len(launched[0])
                 n_esc = len(esc0)
-                self._finish_chunk(launched, band0, cigars, esc0)
+                self._finish_chunk(launched, band0, cigars, esc0, bp_meta)
                 done_pairs += n_chunk - (len(esc0) - n_esc)
                 if progress is not None:
                     progress(done_pairs, len(pairs))
@@ -486,13 +565,22 @@ class TpuAligner(PallasDispatchMixin):
                 raise RuntimeError(
                     f"{len(reject)} pairs rejected and no fallback aligner")
             fb = self.fallback.align_batch([pairs[i] for i in reject])
-            for i, cig in zip(reject, fb):
-                cigars[i] = cig
+            if bp_meta is None:
+                for i, cig in zip(reject, fb):
+                    cigars[i] = cig
+            else:
+                from ..core.overlap import breaking_points_from_cigar
+                w, metas = bp_meta
+                for i, cig in zip(reject, fb):
+                    t_begin, q_off = metas[i]
+                    cigars[i] = breaking_points_from_cigar(
+                        cig, q_off, t_begin,
+                        t_begin + len(pairs[i][1]), w)
         if progress is not None and done_pairs < len(pairs):
             progress(len(pairs), len(pairs))
         return cigars
 
-    def _launch_chunk(self, pairs, chunk, max_len, band):
+    def _launch_chunk(self, pairs, chunk, max_len, band, bp_meta=None):
         """Pack a chunk and dispatch its kernels; returns the in-flight
         handle consumed by ``_finish_chunk``. Device work proceeds
         asynchronously after dispatch.
@@ -552,11 +640,39 @@ class TpuAligner(PallasDispatchMixin):
         if self._use_pallas(shape_key):
             try:
                 out = self._dispatch(args, max_len, band, steps, True)
-                return chunk, pairs, n, m, out
+                out = self._attach_bp(out, chunk, pairs, n, m, max_len,
+                                      bp_meta, put)
+                return chunk, pairs, n, m, out, (max_len, shape_key)
             except Exception as e:
                 self._note_pallas_failure(shape_key, e)
         out = self._dispatch(args, max_len, band, steps, False)
-        return chunk, pairs, n, m, out
+        out = self._attach_bp(out, chunk, pairs, n, m, max_len, bp_meta,
+                              put)
+        return chunk, pairs, n, m, out, (max_len, None)
+
+    def _attach_bp(self, out, chunk, pairs, n, m, max_len, bp_meta, put):
+        """In breaking-points mode, derive the per-boundary tables on
+        device from the (device-resident) packed op stream; the stream
+        itself is never fetched."""
+        if bp_meta is None:
+            return out
+        w, metas = bp_meta
+        ops_packed, score, fi, fj = out
+        B = ops_packed.shape[0]
+        NW = max_len // max(w, 1) + 2
+        first_rel = np.zeros(B, np.int32)
+        nb = np.ones(B, np.int32)
+        for k, idx in enumerate(chunk):
+            t_begin, _ = metas[idx]
+            t_end = t_begin + len(pairs[idx][1])
+            n_reg = (t_end - 1) // w - t_begin // w
+            nb[k] = n_reg + 1
+            first_rel[k] = ((t_begin // w + 1) * w - 1 - t_begin
+                            if n_reg else m[k] - 1)
+        bp_first, bp_last = _breaking_points_kernel(
+            ops_packed, put(n), put(m), put(first_rel), put(nb),
+            w=w, NW=NW)
+        return bp_first, bp_last, score, fi, fj
 
     def _dispatch(self, args, max_len, band, steps, use_pallas):
         if self.mesh is not None:
@@ -567,10 +683,24 @@ class TpuAligner(PallasDispatchMixin):
         return align_chain(*args, max_len=max_len, band=band, steps=steps,
                            use_pallas=use_pallas)
 
-    def _finish_chunk(self, launched, band, cigars, reject):
-        chunk, pairs, n, m, out = launched
+    def _finish_chunk(self, launched, band, cigars, reject, bp_meta=None):
+        chunk, pairs, n, m, out, (max_len, shape_key) = launched
         from ..parallel import fetch_global
-        ops_packed, score, fi, fj = fetch_global(list(out))
+        if bp_meta is not None:
+            try:
+                self._finish_chunk_bp(launched, band, cigars, reject,
+                                      bp_meta)
+            except Exception as e:
+                launched = self._refetch_xla(launched, band, bp_meta, e)
+                self._finish_chunk_bp(launched, band, cigars, reject,
+                                      bp_meta)
+            return
+        try:
+            ops_packed, score, fi, fj = fetch_global(list(out))
+        except Exception as e:
+            launched = self._refetch_xla(launched, band, bp_meta, e)
+            chunk, pairs, n, m, out, _ = launched
+            ops_packed, score, fi, fj = fetch_global(list(out))
         # unpack 4 codes/byte -> [B, 2L] uint8
         shifts = np.array([0, 2, 4, 6], dtype=np.uint8)
         ops = ((ops_packed[:, :, None] >> shifts) & 3).reshape(
@@ -591,3 +721,46 @@ class TpuAligner(PallasDispatchMixin):
                 self.stats["device"] += 1
             else:
                 reject.append(idx)
+
+    def _refetch_xla(self, launched, band, bp_meta, exc):
+        """A Pallas *runtime* fault surfaced at the async fetch (the
+        compile-time probe cannot see DMA/VMEM faults on the real chip):
+        note the shape and re-run the chunk on the XLA kernels
+        (ADVICE r3). Raises if the failed chunk was already XLA."""
+        chunk, pairs, n, m, out, (max_len, shape_key) = launched
+        if shape_key is None:
+            raise exc
+        self._note_pallas_failure(shape_key, exc)
+        return self._launch_chunk(pairs, chunk, max_len, band, bp_meta)
+
+    def _finish_chunk_bp(self, launched, band, results, reject, bp_meta):
+        """Breaking-points decode: the per-boundary tables are already on
+        host-friendly shapes; convert to the walker's absolute-coordinate
+        pair list (same accept/reject gate as the CIGAR path — the walk is
+        complete and provably optimal inside the band, else escalate)."""
+        chunk, pairs, n, m, out, _geom = launched
+        from ..parallel import fetch_global
+        w, metas = bp_meta
+        bp_first, bp_last, score, fi, fj = fetch_global(list(out))
+        BIG = 1 << 30
+        for k, idx in enumerate(chunk):
+            diff = abs(int(n[k]) - int(m[k]))
+            clean = int(fi[k]) == 0 and int(fj[k]) == 0
+            if not (int(score[k]) <= band // 2 - diff - 2 and clean):
+                reject.append(idx)
+                continue
+            t_begin, q_off = metas[idx]
+            bp: List[Tuple[int, int]] = []
+            fp_row, lp_row = bp_first[k], bp_last[k]
+            t_end = t_begin + len(pairs[idx][1])
+            n_reg = (t_end - 1) // w - t_begin // w
+            for b in range(n_reg + 1):
+                fp = int(fp_row[b])
+                if fp >= BIG:
+                    continue
+                lp = int(lp_row[b])
+                bp.append((t_begin + (fp >> 14), q_off + (fp & 0x3FFF)))
+                bp.append((t_begin + (lp >> 14) + 1,
+                           q_off + (lp & 0x3FFF) + 1))
+            results[idx] = bp
+            self.stats["device"] += 1

@@ -752,6 +752,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             inflight = []
             for g in groups:
                 la = self._launch_group(g, Lq, Lb)
+                la["geom"] = (Lq, Lb, steps, Lq2)
                 self._rounds(la, Lq, Lb, steps, Lq2)
                 done_units += 1
                 if progress is not None:
@@ -935,15 +936,34 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 Lq2=Lq2, scores=self.scores)
         launch["state"] = list(out)
 
-    def _finish_group(self, launch, trim: bool, results) -> None:
-        """One host fetch per group; decode consensus bytes + trim."""
+    def _finish_group(self, launch, trim: bool, results,
+                      retried: bool = False) -> None:
+        """One host fetch per group; decode consensus bytes + trim.
+
+        JAX dispatch is async, so a Pallas *runtime* fault (a DMA/VMEM
+        fault on the real chip that the compile-time probe could not see)
+        surfaces here at the fetch — note the shape and re-run the whole
+        group on the XLA kernels instead of aborting the polish
+        (ADVICE r3)."""
         shards, nWp = launch["shards"], launch["nWp"]
         # fetch only what the stitch needs (bg/ed/bweights/frozen stay on
         # device — every transferred byte rides the slow tunnel)
         _, _, bcodes, _, blen, covs, ever, _, dropped = launch["state"]
         from ..parallel import fetch_global
-        bcodes, blen, covs, ever, dropped = fetch_global(
-            [bcodes, blen, covs, ever, dropped])
+        try:
+            bcodes, blen, covs, ever, dropped = fetch_global(
+                [bcodes, blen, covs, ever, dropped])
+        except Exception as e:
+            Lq, Lb, steps, Lq2 = launch["geom"]
+            if retried:
+                raise
+            self._note_pallas_failure((Lq, self.band, steps, Lb, Lq2), e)
+            live = [item for sh in shards for item in sh]
+            relaunch = self._launch_group(live, Lq, Lb)
+            relaunch["geom"] = launch["geom"]
+            self._rounds(relaunch, Lq, Lb, steps, Lq2)
+            self._finish_group(relaunch, trim, results, retried=True)
+            return
         self.stats["dropped_layers"] += int(dropped[:, 0].sum())
         self.stats["sweep_truncated"] += int(dropped[:, 1].sum())
         self.stats["ins_overflow"] += int(dropped[:, 2].sum())

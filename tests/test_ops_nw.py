@@ -76,3 +76,42 @@ def test_oversize_pair_falls_back(aligner):
     (cig,) = aligner.align_batch([(a, a)])
     assert cig == f"{len(a)}M"
     assert aligner.stats["fallback_length"] == before["fallback_length"] + 1
+
+
+def test_breaking_points_match_cigar_walker():
+    """Device breaking points (per-boundary tables computed from the
+    device-resident op stream) must equal walking the device CIGAR with
+    the shared oracle walker, for every pair, strand offset and window
+    phase — including pairs with matchless windows (deletion crossings)."""
+    import numpy as np
+
+    from racon_tpu.core.overlap import breaking_points_from_cigar
+    from racon_tpu.ops.nw import TpuAligner
+
+    rng = np.random.default_rng(29)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    pairs, metas = [], []
+    for k in range(24):
+        ln = int(rng.integers(120, 240))
+        t = bases[rng.integers(0, 4, ln)]
+        q = t.copy()
+        flips = rng.random(ln) < 0.12
+        q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        q = np.delete(q, rng.integers(0, len(q), 5))
+        if k % 4 == 0:  # a long deletion -> a window with no matches
+            cut = int(rng.integers(20, ln - 60))
+            q = np.concatenate([q[:cut], q[cut + 45:]])
+        pairs.append((q.tobytes(), t.tobytes()))
+        metas.append((int(rng.integers(0, 1000)),    # global t_begin
+                      int(rng.integers(0, 500))))    # global q_off
+    w = 64
+
+    from racon_tpu.core.backends import PythonAligner
+    al = TpuAligner(buckets=((256, 128),), fallback=PythonAligner())
+    bps = al.breaking_points_batch(pairs, metas, w)
+    assert al.stats["fallback_length"] > 0  # deletion pairs exercise the
+    cigars = al.align_batch(pairs)        # host-walker fallback path too
+    for k, ((q, t), (t_begin, q_off)) in enumerate(zip(pairs, metas)):
+        oracle = breaking_points_from_cigar(
+            cigars[k], q_off, t_begin, t_begin + len(t), w)
+        assert bps[k] == oracle, f"pair {k}"

@@ -58,3 +58,59 @@ def test_dispatch():
     assert overlap_parser_for("x.mhap") is parse_mhap
     assert overlap_parser_for("x.sam.gz") is parse_sam
     assert overlap_parser_for("x.vcf") is None
+
+
+def test_native_parser_matches_python_oracle(data_dir):
+    """The native zlib parser must produce record-for-record identical
+    output to the Python parsers on the real λ files (gzipped FASTA and
+    FASTQ, multi-record, names with suffixes)."""
+    import racon_tpu.io.parsers as P
+    from racon_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+
+    for fname, is_fastq in (("sample_reads.fasta.gz", False),
+                            ("sample_reads.fastq.gz", True),
+                            ("sample_layout.fasta.gz", False)):
+        path = str(data_dir / fname)
+        got = native.parse_seqfile(path, is_fastq)
+        # bypass the native fast path to reach the Python oracle
+        import unittest.mock as mock
+        with mock.patch.object(P, "_native_records", lambda *a: None):
+            want = list((P.parse_fastq if is_fastq else P.parse_fasta)(path))
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g[0] == w.name and g[1] == w.data and g[2] == w.quality
+
+
+def test_native_parser_rejects_malformed(tmp_path):
+    from racon_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    bad = tmp_path / "bad.fastq"
+    bad.write_bytes(b"not a header\nACGT\n+\n!!!!\n")
+    import pytest
+    with pytest.raises(ValueError, match="malformed FASTQ header"):
+        native.parse_seqfile(str(bad), True)
+    trunc = tmp_path / "trunc.fastq"
+    trunc.write_bytes(b"@r1\nACGTACGT\n+\n!!!\n")
+    with pytest.raises(ValueError, match="truncated FASTQ"):
+        native.parse_seqfile(str(trunc), True)
+
+
+def test_native_parser_skips_leading_header_whitespace(tmp_path):
+    """'>  name extra' must yield b'name' like the Python oracle's
+    split(None, 1)."""
+    from racon_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    f = tmp_path / "pad.fasta"
+    f.write_bytes(b">  ctg1 extra\nACGT\n")
+    (rec,) = native.parse_seqfile(str(f), False)
+    assert rec[0] == b"ctg1" and rec[1] == b"ACGT"

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Record device-engine goldens for every scenario the reference records
-CUDA goldens for (test/racon_test.cpp:292-496): six consensus runs + four
-fragment-correction runs, all through the accelerated engines
+CUDA goldens for (test/racon_test.cpp:292-496): eight consensus runs
+(incl. unit/e2e score sets and banded) + four fragment-correction runs, all through the accelerated engines
 (consensus_backend="tpu"; -f also aligner_backend="tpu"). Prints one line
 per scenario; values are bit-reproducible across the CPU-mesh XLA kernels
 and the on-chip Pallas kernels, so tests assert them exactly.
