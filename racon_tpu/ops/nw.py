@@ -494,15 +494,22 @@ class TpuAligner(PallasDispatchMixin):
             inflight = []
             escaped = {}  # bucket -> indices that escaped its band
             for bi in sorted(by_bucket):
-                indices = by_bucket[bi]
+                # longest first: chunks (and the Pallas kernels' 64-pair
+                # blocks within them) hold similar-length pairs, so the
+                # per-block dynamic sweep bound cuts the short blocks'
+                # dead wavefronts instead of averaging against the max
+                indices = sorted(
+                    by_bucket[bi],
+                    key=lambda i: -(len(pairs[i][0]) + len(pairs[i][1])))
                 max_len, band = self.buckets[bi]
                 # budget by the real sweep bound, not the worst case: the
                 # direction matrix is (B, steps, band/8) and steps tracks
                 # the longest pair in the bucket — budgeting 2*max_len
                 # halved the chunk size (and doubled the dispatch syncs)
-                # for typical pairs well under the bucket cap
-                max_nm = max(len(pairs[i][0]) + len(pairs[i][1])
-                             for i in indices)
+                # for typical pairs well under the bucket cap (indices
+                # are sorted longest-first, so the head is the max)
+                max_nm = (len(pairs[indices[0]][0])
+                          + len(pairs[indices[0]][1]))
                 steps_est = _sweep_bound(max_nm, max_len)
                 raw_cap = (self.max_dirs_bytes // self.num_batches
                            ) // (steps_est * (band // 8))

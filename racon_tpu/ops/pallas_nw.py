@@ -57,6 +57,11 @@ import jax.experimental.pallas.tpu as pltpu
 _BIG = 1 << 28
 # extra tail lanes so aligned-window loads never run off the char arrays
 _LOAD_PAD = 256
+# Per-block dynamic sweep bounds (traced loop trip counts): blocks stop
+# at their longest pair's sweep. Off-switch for A/B measurement — traced
+# trip counts can inhibit Mosaic's static loop optimizations.
+import os as _os
+DYNAMIC_BOUND = _os.environ.get("RACON_TPU_DYNBOUND", "1") != "0"
 # pair-block (sublane) caps: the TPU grid is sequential, so bigger blocks
 # amortize per-step loop/DMA overhead across more pairs; 64 measured best
 # on v5e for both kernels (32 leaves ~30% on the table, 128 regresses the
@@ -242,19 +247,39 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
                                       qch, tch)
         return v1, v2, score, dbuf, qch
 
+    # per-block dynamic sweep bound: no wavefront beyond the block's
+    # longest pair ever matters (scores land at a == n+m; the walks only
+    # read rows a <= n+m), so the trip count is traced — blocks of short
+    # (or zero-length) pairs stop early. The bound rounds to whole
+    # flush-DMA groups so the staging protocol stays intact; unwritten
+    # dirs rows past the bound are never read.
+    # round to whole flush-DMA groups (F*PER steps) AND whole walk
+    # chunks (128 rows), so the staging protocol stays intact and the
+    # walks' chunk DMAs never read unwritten rows; F and PER are powers
+    # of two, so one of the two dominates
+    QB = max(128, F * PER)
+    assert QB % 128 == 0 and QB % (F * PER) == 0, (F, PER)
+    if DYNAMIC_BOUND:
+        maxnm = jnp.max(nn + mm)
+        bound = jnp.minimum(jnp.int32(S), ((maxnm + QB - 1) // QB) * QB)
+    else:
+        bound = jnp.int32(S)
+
     _, _, score, _, _ = lax.fori_loop(
-        0, S // 2, two_steps, (v0, vm1, score0, dbuf0, qch0))
+        0, bound // 2, two_steps, (v0, vm1, score0, dbuf0, qch0))
     score_ref[:, :] = score
 
-    # drain outstanding DMAs (one or two slots in flight at the end)
-    NF = S // F
-    last = NF - 1
+    # drain outstanding DMAs (one or two slots in flight at the end).
+    # Slot indices stay static: each slot's last flush group is derived
+    # from the traced bound and guarded by whether it ever fired.
+    NFb = bound // F
+    last = NFb // PER - 1  # last flush-group index (groups are PER flushes)
+    for s in (0, 1):
+        g = last - ((last - s) % 2)
 
-    @pl.when(NF >= 2 * PER)
-    def _():
-        stage_dma(((last // PER) - 1) % 2, last - PER).wait()
-
-    stage_dma((last // PER) % 2, last).wait()
+        @pl.when((NFb > 0) & (g >= 0))
+        def _(s=s, g=g):
+            stage_dma(s, (g + 1) * PER - 1).wait()
 
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
@@ -359,6 +384,34 @@ def _walk_step_decode(buf, slot, lo, a, i, j, lane_ww, *, c, U, RB, WW):
     return op, di, dj, active
 
 
+def _walk_start(nn, mm, chunk_dma, blank_row, *, S: int, C: int,
+                CHUNKS: int):
+    """Shared dynamic-start preamble of both walk kernels: compute the
+    first live chunk (the walk begins at a = n + m, so leading
+    descending-a chunks with no active pair are skipped), blank the
+    skipped output rows via ``blank_row(offset)`` so consumers see
+    exactly what the XLA walk emits there, and prefetch the first live
+    chunk's DMA (skipped entirely when the block has nothing to walk)."""
+    if DYNAMIC_BOUND:
+        maxnm = jnp.max(nn + mm)
+        k0 = (S - jnp.minimum(jnp.int32(S),
+                              ((maxnm + C - 1) // C) * C)) // C
+    else:
+        k0 = jnp.int32(0)
+
+    def blank(k, _):
+        blank_row(pl.multiple_of(k * C, 128))
+        return 0
+
+    lax.fori_loop(0, k0, blank, 0)
+
+    @pl.when(k0 < CHUNKS)
+    def _():
+        chunk_dma(k0 % 2, k0).start()
+
+    return k0
+
+
 def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
                  buf, sems, *, band: int, P: int, C: int, steps: int):
     W = band
@@ -375,7 +428,26 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
     chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
                                    P=P, C=C, RB=RB, S=S)
 
-    chunk_dma(0, 0).start()
+    # per-block dynamic start: the walk begins at a = n + m, so leading
+    # chunks (descending-a order) with no active pair are skipped — their
+    # output rows are blanked to the inactive code so consumers see
+    # exactly what the XLA walk emits for those steps
+    if DYNAMIC_BOUND:
+        maxnm = jnp.max(nn + mm)
+        k0 = (S - jnp.minimum(jnp.int32(S),
+                              ((maxnm + C - 1) // C) * C)) // C
+    else:
+        k0 = jnp.int32(0)
+
+    def blank(k, _):
+        ops_ref[:, pl.ds(k * C, C)] = jnp.full((P, C), 3, jnp.uint8)
+        return 0
+
+    lax.fori_loop(0, k0, blank, 0)
+
+    @pl.when(k0 < CHUNKS)  # k0 == CHUNKS: nothing to walk at all
+    def _():
+        chunk_dma(k0 % 2, k0).start()
     # min(nn, 0) == 0 forces a row-varying carry layout (_fwd_kernel note)
     obuf0 = jnp.full((P, 128), 3, jnp.int32) + jnp.minimum(nn, 0)
 
@@ -411,7 +483,7 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
 
         return lax.fori_loop(0, C, step_body, (i, j, obuf))
 
-    fi, fj, _ = lax.fori_loop(0, CHUNKS, chunk_body, (nn, mm, obuf0))
+    fi, fj, _ = lax.fori_loop(k0, CHUNKS, chunk_body, (nn, mm, obuf0))
     fi_ref[:, :] = fi
     fj_ref[:, :] = fj
 
@@ -538,8 +610,13 @@ def pallas_ok() -> bool:
                                             band=band)
             dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx = map(
                 np.asarray, (dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx))
+            # rows past the block's dynamic sweep bound are never written
+            # by the Pallas kernel (and never read by any consumer) —
+            # compare only the guaranteed-computed rows
+            mx = int((n + m).max())
             ok = (
-                np.array_equal(dp, dx) and np.array_equal(sp, sx)
+                np.array_equal(dp[:, :mx], dx[:, :mx])
+                and np.array_equal(sp, sx)
                 and np.array_equal(fip, fix) and np.array_equal(fjp, fjx)
                 and all(np.array_equal(op_[k][op_[k] < 3], ox[k][ox[k] < 3])
                         for k in range(B)))
@@ -619,7 +696,11 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
     chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
                                    P=P, C=C, RB=RB, S=S)
 
-    chunk_dma(0, 0).start()
+    def blank_row(off):
+        idx_ref[:, pl.ds(off, C)] = jnp.full((P, C), VOT, jnp.int32)
+        w_ref[:, pl.ds(off, C)] = jnp.zeros((P, C), jnp.uint8)
+
+    k0 = _walk_start(nn, mm, chunk_dma, blank_row, S=S, C=C, CHUNKS=CHUNKS)
     zrow = jnp.minimum(nn, 0)
     ibuf0 = jnp.full((P, 128), VOT, jnp.int32) + zrow
     wbuf0 = jnp.zeros((P, 128), jnp.int32) + zrow
@@ -680,7 +761,7 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
         return lax.fori_loop(0, C, step_body, (i, j, run, ibuf, wbuf))
 
     fi, fj, _, _, _ = lax.fori_loop(
-        0, CHUNKS, chunk_body, (nn, mm, zrow, ibuf0, wbuf0))
+        k0, CHUNKS, chunk_body, (nn, mm, zrow, ibuf0, wbuf0))
     fi_ref[:, :] = fi
     fj_ref[:, :] = fj
 
