@@ -151,19 +151,20 @@ def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
     from ..ops.poa import refine_loop
 
     def local(n, qcodes, qweights, win_of, real, bg, ed,
-              bcodes, bweights, blen, covs, ever, frozen, dropped,
+              bcodes, bweights, blen, covs, ever, frozen, conv, dropped,
               ins_theta, del_beta):
         return refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
                            bcodes, bweights, blen, covs, ever, frozen,
-                           dropped, ins_theta, del_beta, rounds=rounds,
+                           conv, dropped, ins_theta, del_beta,
+                           rounds=rounds,
                            n_windows=n_windows_local, max_len=max_len,
                            band=band, Lb=Lb, K=K, steps=steps,
                            use_pallas=use_pallas, Lq2=Lq2, scores=scores)
 
     spec = P(AXIS)
     return jax.jit(jax.shard_map(
-        local, mesh=mesh, in_specs=(spec,) * 14 + (P(), P()),
-        out_specs=(spec,) * 9, check_vma=False))
+        local, mesh=mesh, in_specs=(spec,) * 15 + (P(), P()),
+        out_specs=(spec,) * 10, check_vma=False))
 
 
 def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
@@ -178,7 +179,7 @@ def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
     ``static`` = (n, qcodes, qweights, win_of, real) with leading dim
     ``n_shards * B_local``; ``win_of`` holds **shard-local** window
     ordinals.  ``state`` = (bg, ed, bcodes, bweights, blen, covs, ever,
-    frozen, dropped) — pair-major arrays share the pair stacking, window
+    frozen, conv, dropped) — pair-major arrays share the pair stacking, window
     rows have leading dim ``n_shards * n_windows_local``, ``dropped`` is
     a [n_shards, 3] telemetry row per shard.  Pairs belonging to one
     window must live in that window's shard — :func:`partition_balanced`

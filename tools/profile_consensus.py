@@ -135,8 +135,8 @@ def main():
         total_pairs = s
     launch = eng._launch_group(live, Lq, Lb)
     n_, qcodes, qweights, win_of, real = launch["static"]
-    bg, ed, bcodes, bweights, blen, covs, ever, frozen, dropped = \
-        launch["state"]
+    (bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
+     dropped) = launch["state"]
     nWp = launch["nWp"]
     B = qcodes.shape[0]
     print(f"pairs={total_pairs} B={B} Lq={Lq} Lb={Lb} steps={steps} "
@@ -227,7 +227,7 @@ def main():
 
     rr = lambda: refine_round(
         n_, qcodes, qweights, win_of, real, bg, ed, bcodes, bweights,
-        blen, covs, ever, frozen, dropped,
+        blen, covs, ever, frozen, conv, dropped,
         jnp.float32(eng.ins_theta), jnp.float32(eng.del_beta),
         n_windows=nWp, max_len=Lq, band=band, Lb=Lb, K=K_INS,
         steps=steps, use_pallas=use_pallas)
