@@ -216,16 +216,16 @@ def bench_scale():
     warm = time.perf_counter() - t0
     log(f"scale warm: {warm:.2f}s ({n_windows / warm:.1f} windows/s, "
         f"{mbp / warm:.3f} Mbp/s)")
-    # device-utilization estimate at scale: real DP lane-updates across
-    # the refinement rounds (pairs x rounds x (n+m) wavefronts x band/2
-    # lanes x ~20 VPU ops per lane-update) vs the VPU's rough int32 peak
-    # (8x128 lanes x 2 ops/cycle x ~0.94 GHz on v5e). Walk/vote/rebuild
-    # work rides along uncounted, so this is a lower bound.
-    from racon_tpu.ops.poa import BAND, TpuPoaConsensus as _T
-    import inspect
-    rounds = inspect.signature(_T.__init__).parameters["rounds"].default
-    n_layers = 30 * n_windows
-    cells = n_layers * rounds * 1030 * (BAND // 2)
+    # device-utilization estimate at scale: EXECUTED DP lane-updates
+    # (the engine counts post-convergence-gating wavefront steps on
+    # device — pairs whose window converged are zeroed and do no DP, so
+    # skipped work is not credited) x band/2 lanes x ~20 VPU ops per
+    # lane-update, vs the VPU's rough int32 peak (8x128 lanes x 2
+    # ops/cycle x ~0.94 GHz on v5e). Walk/vote/rebuild work rides along
+    # uncounted, so this is a lower bound on busy-ness but an honest
+    # count of useful alignment work per wall-second.
+    from racon_tpu.ops.poa import BAND
+    cells = tpu.stats["wavefront_steps"] * (BAND // 2)
     vpu_util = cells * 20 / warm / (8 * 128 * 2 * 0.94e9)
     return {
         "scale_mbp": mbp,

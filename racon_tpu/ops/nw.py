@@ -241,9 +241,11 @@ def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0,
     if use_pallas:
         from .pallas_nw import pallas_nw_fwd, pallas_walk_ops
         packed, score = pallas_nw_fwd(qrp, tp, n, m, max_len=max_len,
-                                      band=band, steps=steps)
-        ops, fi, fj = pallas_walk_ops(packed, n, m, band=band)
-        return _pack_ops(ops), score, fi, fj
+                                      band=band, steps=steps,
+                                      out_quant=512)
+        # the Pallas walk emits the packed op stream directly
+        ops_packed, fi, fj = pallas_walk_ops(packed, n, m, band=band)
+        return ops_packed, score, fi, fj
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                          max_len=max_len, band=band,
                                          steps=steps)
@@ -304,13 +306,14 @@ def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
 def _sweep_bound(max_nm: int, max_len: int) -> int:
     """Anti-diagonal sweep bound for a bucket/chunk: the longest real pair
     rounded coarsely (1024 for long buckets, so per-chunk shapes stay
-    compile-cache-friendly), capped at the full sweep, multiple of 128
+    compile-cache-friendly), capped at the full sweep, multiple of 512
     (the Pallas kernels' granularity: every band's flush period
-    F = FL/RB and the walk chunk C divide 128). Shared by the chunk
-    launcher and the memory-budget sizing so they account identically."""
-    quant = 128 if max_len <= 1024 else 1024
+    F = FL/RB divides 128 and the packed walk flushes 128-byte output
+    groups of 512 steps). Shared by the chunk launcher and the
+    memory-budget sizing so they account identically."""
+    quant = 512 if max_len <= 1024 else 1024
     steps = min(-(-max_nm // quant) * quant, 2 * max_len)
-    return -(-steps // 128) * 128
+    return -(-steps // 512) * 512
 
 
 @functools.partial(jax.jit, static_argnames=("w", "NW"))
@@ -335,6 +338,12 @@ def _breaking_points_kernel(ops_packed, n, m, first_rel, nb, *, w: int,
 
     Identical for both walk backends: gap-code placement differs but the
     M steps' (tpos, qpos) sets are equal and min/max are order-free.
+
+    Per-interval aggregation is ``NW`` (static, ~10-34) masked reduces
+    over the [B, S] step stream rather than a scatter-min/max: XLA's
+    scatter engine crawls the ~4M updates of a full chunk at ~90M/s
+    (~45 ms per table — it used to cost more than the DP itself), while
+    the masked reduces are streaming VPU passes (~5 ms total).
     """
     B, S4 = ops_packed.shape
     S = S4 * 4
@@ -356,14 +365,13 @@ def _breaking_points_kernel(ops_packed, n, m, first_rel, nb, *, w: int,
         -(-(tpos - first_rel[:, None]) // w), 0, nb[:, None] - 1)
     valid = is_M & is_real & (tpos >= 0)
     packed = jnp.where(valid, (tpos << 14) | jnp.maximum(qpos, 0), BIG)
-    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-    flat = jnp.where(valid, rows * NW + widx, B * NW)
 
-    bp_first = jnp.full(B * NW + 1, BIG, jnp.int32).at[
-        flat.reshape(-1)].min(packed.reshape(-1))[:B * NW].reshape(B, NW)
-    bp_last = jnp.full(B * NW + 1, -1, jnp.int32).at[
-        flat.reshape(-1)].max(jnp.where(valid, packed, -1).reshape(-1)
-                              )[:B * NW].reshape(B, NW)
+    bp_first = jnp.stack(
+        [jnp.min(jnp.where(widx == k, packed, BIG), axis=1)
+         for k in range(NW)], axis=1)
+    bp_last = jnp.stack(
+        [jnp.max(jnp.where(valid & (widx == k), packed, -1), axis=1)
+         for k in range(NW)], axis=1)
     bp_last = lax.cummax(bp_last, axis=1)
     return bp_first, bp_last
 

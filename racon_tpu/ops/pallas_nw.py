@@ -116,7 +116,7 @@ def _load_window(ref, off, width: int, U: int):
 
 def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
                 stage, dsems, *, max_len: int, band: int, P: int,
-                width: int, steps: int, PER: int):
+                width: int, steps: int, PER: int, out_quant: int):
     W = band
     c = W // 2
     L = max_len
@@ -155,13 +155,20 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
     zrow = jnp.minimum(nn, 0)
     v0 = jnp.where(us == u0, 0, _BIG) + zrow
     vm1 = jnp.full((P, U), _BIG, jnp.int32) + zrow
-    score0 = jnp.where(nn + mm == 0, 0, _BIG)
+    # final scores accumulate elementwise into a (P, U) vector (one lane
+    # per pair is ever written); the cross-lane reduce happens ONCE after
+    # the sweep instead of once per wavefront
+    svec0 = jnp.full((P, U), _BIG, jnp.int32) + zrow
     dbuf0 = jnp.zeros((P, FL), jnp.int32) + zrow
 
-    def substep(a, p, v1, v2, score, dbuf, qchars, tchars):
+    def substep(a, p, v1, v2, svec, dbuf, qchars, tchars, trim):
         """One wavefront with *statically known* parity ``p`` (the
         two-step loop body alternates p=1 then p=0, so every branch on
-        parity folds at trace time)."""
+        parity folds at trace time). ``trim`` (static) drops the DP
+        boundary-row/column handling: for a > c the band sits strictly
+        inside the table (i >= 1 and j >= 1 on every lane), so only the
+        upper length bounds remain — the bulk of the sweep runs ~6 fewer
+        VPU ops per lane."""
         I0 = (a + c - p) // 2
         J0 = (a - c + p) // 2
         i_vec = I0 - us
@@ -186,15 +193,21 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
         best = jnp.minimum(cd, jnp.minimum(ci, cdel))
         d = jnp.where(cd == best, 0, jnp.where(ci == best, 1, 2))
 
-        interior = (i_vec >= 1) & (i_vec <= nn) & (j_vec >= 1) & (j_vec <= mm)
-        v = jnp.where(interior, jnp.minimum(best, _BIG), _BIG)
-        v = jnp.where((i_vec == 0) & (j_vec >= 0) & (j_vec <= mm), j_vec, v)
-        v = jnp.where((j_vec == 0) & (i_vec >= 1) & (i_vec <= nn), i_vec, v)
+        if trim:
+            interior = (i_vec <= nn) & (j_vec <= mm)
+            v = jnp.where(interior, jnp.minimum(best, _BIG), _BIG)
+        else:
+            interior = ((i_vec >= 1) & (i_vec <= nn)
+                        & (j_vec >= 1) & (j_vec <= mm))
+            v = jnp.where(interior, jnp.minimum(best, _BIG), _BIG)
+            v = jnp.where((i_vec == 0) & (j_vec >= 0) & (j_vec <= mm),
+                          j_vec, v)
+            v = jnp.where((j_vec == 0) & (i_vec >= 1) & (i_vec <= nn),
+                          i_vec, v)
 
         # final score lives at a == n + m, u_fin = (m - n + c - p) / 2
         u_fin = jnp.clip((mm - nn + c - p) // 2, 0, U - 1)
-        fin = jnp.sum(jnp.where(us == u_fin, v, 0), axis=1, keepdims=True)
-        score = jnp.where(a == nn + mm, fin, score)
+        svec = jnp.where((a == nn + mm) & (us == u_fin), v, svec)
 
         packed = (d[:, :RB] | (d[:, RB:2 * RB] << 2)
                   | (d[:, 2 * RB:3 * RB] << 4) | (d[:, 3 * RB:] << 6))
@@ -224,7 +237,7 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
             def _():
                 stage_dma(slot, fidx).start()
 
-        return v, v1, score, dbuf
+        return v, v1, svec, dbuf
 
     # two wavefronts per iteration: with even c, parity is a & 1, so the
     # body sees p statically — and the character windows only advance on
@@ -235,29 +248,30 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
     assert c % 2 == 0, "band/2 must be even for the two-step parity fold"
     qch0 = _load_window(qrp_ref, c + L - c // 2, width, U)
 
-    def two_steps(k, carry):
-        v1, v2, score, dbuf, qch = carry
+    def two_steps(k, carry, trim):
+        v1, v2, svec, dbuf, qch = carry
         a1 = 2 * k + 1                   # p = 1
         tch = _load_window(tp_ref, c + (a1 - c + 1) // 2 - 1, width, U)
-        v1, v2, score, dbuf = substep(a1, 1, v1, v2, score, dbuf,
-                                      qch, tch)
+        v1, v2, svec, dbuf = substep(a1, 1, v1, v2, svec, dbuf,
+                                     qch, tch, trim)
         a2 = 2 * k + 2                   # p = 0
         qch = _load_window(qrp_ref, c + L - (a2 + c) // 2, width, U)
-        v1, v2, score, dbuf = substep(a2, 0, v1, v2, score, dbuf,
-                                      qch, tch)
-        return v1, v2, score, dbuf, qch
+        v1, v2, svec, dbuf = substep(a2, 0, v1, v2, svec, dbuf,
+                                     qch, tch, trim)
+        return v1, v2, svec, dbuf, qch
 
     # per-block dynamic sweep bound: no wavefront beyond the block's
     # longest pair ever matters (scores land at a == n+m; the walks only
     # read rows a <= n+m), so the trip count is traced — blocks of short
-    # (or zero-length) pairs stop early. The bound rounds to whole
-    # flush-DMA groups so the staging protocol stays intact; unwritten
-    # dirs rows past the bound are never read.
-    # round to whole flush-DMA groups (F*PER steps) AND whole walk
-    # chunks (128 rows), so the staging protocol stays intact and the
-    # walks' chunk DMAs never read unwritten rows; F and PER are powers
-    # of two, so one of the two dominates
-    QB = max(128, F * PER)
+    # (or zero-length) pairs stop early. Unwritten dirs rows past the
+    # bound are never read.
+    # round to whole flush-DMA groups (F*PER steps) AND whole consumer
+    # read groups (``out_quant``: 512 rows = 4 chunks for the packed
+    # aligner walk, which rounds its start DOWN to a 512-row group; 128
+    # for the consensus vote walk), so the staging protocol stays intact
+    # and the walks' chunk DMAs never read unwritten rows; F and PER are
+    # powers of two <= 256, so one quantum divides the other
+    QB = max(out_quant, F * PER)
     assert QB % 128 == 0 and QB % (F * PER) == 0, (F, PER)
     if DYNAMIC_BOUND:
         maxnm = jnp.max(nn + mm)
@@ -265,9 +279,17 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
     else:
         bound = jnp.int32(S)
 
-    _, _, score, _, _ = lax.fori_loop(
-        0, bound // 2, two_steps, (v0, vm1, score0, dbuf0, qch0))
-    score_ref[:, :] = score
+    # split the sweep at a == c: boundary rows/columns can only appear on
+    # wavefronts a <= c (i == 0 needs I0 < U, j == 0 needs J0 <= 0), so
+    # every later wavefront runs the trimmed substep
+    ksplit = jnp.minimum(jnp.int32(c // 2), bound // 2)
+    carry = lax.fori_loop(
+        0, ksplit, functools.partial(two_steps, trim=False),
+        (v0, vm1, svec0, dbuf0, qch0))
+    _, _, svec, _, _ = lax.fori_loop(
+        ksplit, bound // 2, functools.partial(two_steps, trim=True), carry)
+    score = jnp.min(svec, axis=1, keepdims=True)
+    score_ref[:, :] = jnp.where(nn + mm == 0, 0, score)
 
     # drain outstanding DMAs (one or two slots in flight at the end).
     # Slot indices stay static: each slot's last flush group is derived
@@ -282,12 +304,17 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
             stage_dma(s, (g + 1) * PER - 1).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
+@functools.partial(jax.jit, static_argnames=("max_len", "band", "steps",
+                                             "out_quant"))
 def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
-                  steps: int = 0):
+                  steps: int = 0, out_quant: int = 128):
     """Drop-in Pallas replacement for ``_nw_wavefront_kernel``: same
     inputs, same packed direction matrix [B, steps, RB] and scores [B]
-    (``steps`` defaults to the full ``2*max_len`` sweep)."""
+    (``steps`` defaults to the full ``2*max_len`` sweep). ``out_quant``
+    is the downstream walk's read granularity in rows: 512 when the
+    packed-output aligner walk consumes the matrix, 128 (default) for
+    the consensus vote walk — the dynamic sweep bound rounds up to it so
+    the consumer never reads unwritten rows."""
     B0, width = qrp.shape
     if B0 < 8:
         qrp, tp, n, m = _pad_rows([qrp, tp, n, m], B0, [0, 0, 1, 1])
@@ -312,7 +339,8 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
     qrp = jnp.pad(qrp, ((0, 0), (0, _LOAD_PAD)))
     tp = jnp.pad(tp, ((0, 0), (0, _LOAD_PAD)))
     kernel = functools.partial(_fwd_kernel, max_len=max_len, band=band,
-                               P=P, width=width, steps=S, PER=PER)
+                               P=P, width=width, steps=S, PER=PER,
+                               out_quant=out_quant)
     dirs, score = pl.pallas_call(
         kernel,
         grid=(B // P,),
@@ -384,26 +412,29 @@ def _walk_step_decode(buf, slot, lo, a, i, j, lane_ww, *, c, U, RB, WW):
     return op, di, dj, active
 
 
-def _walk_start(nn, mm, chunk_dma, blank_row, *, S: int, C: int,
-                CHUNKS: int):
+def _walk_start(nn, mm, chunk_dma, blank_group, *, S: int, C: int,
+                CHUNKS: int, group_chunks: int = 1):
     """Shared dynamic-start preamble of both walk kernels: compute the
     first live chunk (the walk begins at a = n + m, so leading
     descending-a chunks with no active pair are skipped), blank the
-    skipped output rows via ``blank_row(offset)`` so consumers see
+    skipped output range via ``blank_group(g)`` (group ``g`` covers
+    chunks ``[g*group_chunks, (g+1)*group_chunks)`` — the packed-output
+    walk needs 4 chunks per 128-byte-aligned store) so consumers see
     exactly what the XLA walk emits there, and prefetch the first live
     chunk's DMA (skipped entirely when the block has nothing to walk)."""
     if DYNAMIC_BOUND:
         maxnm = jnp.max(nn + mm)
         k0 = (S - jnp.minimum(jnp.int32(S),
                               ((maxnm + C - 1) // C) * C)) // C
+        k0 = (k0 // group_chunks) * group_chunks
     else:
         k0 = jnp.int32(0)
 
-    def blank(k, _):
-        blank_row(pl.multiple_of(k * C, 128))
+    def blank(g, _):
+        blank_group(g)
         return 0
 
-    lax.fori_loop(0, k0, blank, 0)
+    lax.fori_loop(0, k0 // group_chunks, blank, 0)
 
     @pl.when(k0 < CHUNKS)
     def _():
@@ -414,12 +445,18 @@ def _walk_start(nn, mm, chunk_dma, blank_row, *, S: int, C: int,
 
 def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
                  buf, sems, *, band: int, P: int, C: int, steps: int):
+    """Walk emitting the aligner's 2-bit x 4-per-byte PACKED op stream
+    directly (``ops_ref`` is [B, S//4] uint8): the downstream `_pack_ops`
+    pass disappears, the output writes shrink 4x, and the rolling output
+    buffer shifts once per 4 steps instead of every step. The inner loop
+    is unrolled 4 steps per iteration so the 2-bit shifts stay static."""
     W = band
     c = W // 2
     U = W // 2
     RB = U // 4
     S = steps
     CHUNKS = S // C
+    GC = 512 // C              # chunks per 128-byte output flush group
     WW = _rup(128 + RB, 128)   # byte-select window (row may straddle 128s)
     blk = pl.program_id(0)
     nn = n_ref[:, :]
@@ -428,28 +465,15 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
     chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
                                    P=P, C=C, RB=RB, S=S)
 
-    # per-block dynamic start: the walk begins at a = n + m, so leading
-    # chunks (descending-a order) with no active pair are skipped — their
-    # output rows are blanked to the inactive code so consumers see
-    # exactly what the XLA walk emits for those steps
-    if DYNAMIC_BOUND:
-        maxnm = jnp.max(nn + mm)
-        k0 = (S - jnp.minimum(jnp.int32(S),
-                              ((maxnm + C - 1) // C) * C)) // C
-    else:
-        k0 = jnp.int32(0)
+    def blank_group(g):
+        # 4 steps of the inactive code 3 pack to 0xFF
+        ops_ref[:, pl.ds(pl.multiple_of(g * 128, 128), 128)] = \
+            jnp.full((P, 128), 255, jnp.uint8)
 
-    def blank(k, _):
-        ops_ref[:, pl.ds(k * C, C)] = jnp.full((P, C), 3, jnp.uint8)
-        return 0
-
-    lax.fori_loop(0, k0, blank, 0)
-
-    @pl.when(k0 < CHUNKS)  # k0 == CHUNKS: nothing to walk at all
-    def _():
-        chunk_dma(k0 % 2, k0).start()
+    k0 = _walk_start(nn, mm, chunk_dma, blank_group, S=S, C=C,
+                     CHUNKS=CHUNKS, group_chunks=GC)
     # min(nn, 0) == 0 forces a row-varying carry layout (_fwd_kernel note)
-    obuf0 = jnp.full((P, 128), 3, jnp.int32) + jnp.minimum(nn, 0)
+    obuf0 = jnp.full((P, 128), 255, jnp.int32) + jnp.minimum(nn, 0)
 
     def chunk_body(k, carry):
         i, j, obuf = carry
@@ -462,26 +486,33 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
         chunk_dma(slot, k).wait()
         lo = S - (k + 1) * C
 
-        def step_body(s, carry):
-            i, j, obuf = carry                # (P, 1) positions before step
-            a = S - (k * C + s)               # global anti-diagonal, desc.
-            t = k * C + s                     # emitted step index, asc.
-            op, di, dj, _ = _walk_step_decode(buf, slot, lo, a, i, j,
-                                              lane_ww, c=c, U=U, RB=RB,
-                                              WW=WW)
+        def quad_body(s4, carry):
+            i, j, obuf = carry            # (P, 1) positions before step
+            cur = jnp.zeros((P, 1), jnp.int32)
+            for r in range(4):
+                t = k * C + s4 * 4 + r    # emitted step index, asc.
+                a = S - t                 # global anti-diagonal, desc.
+                op, di, dj, _ = _walk_step_decode(buf, slot, lo, a, i, j,
+                                                  lane_ww, c=c, U=U, RB=RB,
+                                                  WW=WW)
+                cur = cur | (op << (2 * r))
+                i = i - di
+                j = j - dj
 
-            # rolling op buffer, flushed 128-aligned every 128 steps
+            # rolling packed-byte buffer, flushed 128-aligned every
+            # 128 bytes (= 512 steps)
             obuf = pltpu.roll(obuf, shift=127, axis=1)
-            obuf = jnp.concatenate([obuf[:, :127], op], axis=1)
+            obuf = jnp.concatenate([obuf[:, :127], cur], axis=1)
+            q = (k * C) // 4 + s4         # global packed-byte index
 
-            @pl.when((t + 1) % 128 == 0)
+            @pl.when((q + 1) % 128 == 0)
             def _():
-                off = pl.multiple_of(t + 1 - 128, 128)
+                off = pl.multiple_of(q + 1 - 128, 128)
                 ops_ref[:, pl.ds(off, 128)] = obuf.astype(jnp.uint8)
 
-            return i - di, j - dj, obuf
+            return i, j, obuf
 
-        return lax.fori_loop(0, C, step_body, (i, j, obuf))
+        return lax.fori_loop(0, C // 4, quad_body, (i, j, obuf))
 
     fi, fj, _ = lax.fori_loop(k0, CHUNKS, chunk_body, (nn, mm, obuf0))
     fi_ref[:, :] = fi
@@ -492,9 +523,11 @@ def _walk_kernel(dirs_ref, n_ref, m_ref, ops_ref, fi_ref, fj_ref,
 def pallas_walk_ops(dirs, n, m, *, band: int):
     """Wavefront-synchronized walk over the packed direction matrix.
 
-    Same (ops, fi, fj) contract as ``_walk_ops_kernel`` up to inactive-gap
-    placement (codes >= 3 interleave with the path after M steps); all
-    consumers mask on ``op < 3``.
+    Returns ``(ops_packed [B, S//4] u8, fi, fj)`` — the same 2-bit x
+    4-per-byte packing `_pack_ops` produces from the XLA walk, and the
+    same op semantics up to inactive-gap placement (codes >= 3 interleave
+    with the path after M steps); all consumers mask on ``op < 3`` after
+    unpacking.
     """
     B0 = dirs.shape[0]
     if B0 < 8:
@@ -502,10 +535,11 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
     B, S, RB = dirs.shape
     C = min(128, S)
     P = _cap_block(B, 2 * (C * RB + _rup(128 + RB, 128)), _WALK_BUF_BYTES)
-    if S % C:
+    if S % 512:
         raise ValueError(
-            f"steps={S} must be a multiple of the walk chunk ({C}); "
-            f"round steps up to a multiple of 128")
+            f"steps={S} must be a multiple of 512 (the packed walk "
+            f"flushes 128-byte output groups of 4 chunks); round steps "
+            f"up to a multiple of 512")
     kernel = functools.partial(_walk_kernel, band=band, P=P, C=C, steps=S)
     ops, fi, fj = pl.pallas_call(
         kernel,
@@ -516,12 +550,13 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((P, S), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((P, S // 4), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, S), jnp.uint8),
+            jax.ShapeDtypeStruct((B, S // 4), jnp.uint8),
             jax.ShapeDtypeStruct((B, 1), jnp.int32),
             jax.ShapeDtypeStruct((B, 1), jnp.int32),
         ],
@@ -602,14 +637,20 @@ def pallas_ok() -> bool:
                 n[k], m[k] = len(q), ln
             args = (jnp.asarray(qrp), jnp.asarray(tp),
                     jnp.asarray(n), jnp.asarray(m))
-            dp, sp = pallas_nw_fwd(*args, max_len=max_len, band=band)
+            # out_quant=512: this matrix feeds the packed aligner walk
+            dp, sp = pallas_nw_fwd(*args, max_len=max_len, band=band,
+                                   out_quant=512)
             dx, sx = _nw_wavefront_kernel(*args, max_len=max_len, band=band)
-            op_, fip, fjp = pallas_walk_ops(dp, args[2], args[3],
+            opk, fip, fjp = pallas_walk_ops(dp, args[2], args[3],
                                             band=band)
             ox, fix, fjx = _walk_ops_kernel(dx, args[2], args[3],
                                             band=band)
-            dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx = map(
-                np.asarray, (dp, sp, dx, sx, op_, fip, fjp, ox, fix, fjx))
+            dp, sp, dx, sx, opk, fip, fjp, ox, fix, fjx = map(
+                np.asarray, (dp, sp, dx, sx, opk, fip, fjp, ox, fix, fjx))
+            # the Pallas walk's output is 2-bit packed — unpack to compare
+            shifts4 = np.arange(4, dtype=np.uint8) * 2
+            op_ = ((opk[:, :, None] >> shifts4) & 3).reshape(opk.shape[0],
+                                                             -1)
             # rows past the block's dynamic sweep bound are never written
             # by the Pallas kernel (and never read by any consumer) —
             # compare only the guaranteed-computed rows
@@ -672,9 +713,11 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
     batched gathers) disappears entirely; the XLA side only folds in
     ``win_of``, applies the per-pair ``ok`` gate, and scatter-adds.
 
-    The layer base/weight lookups are per-pair masked max-reduces over the
-    (P, Lq) query rows held in VMEM (only one lane matches ``i - 1``, so
-    max == select; weights are integral 0..93 and travel as uint8).
+    The layer base/weight lookup is ONE per-pair masked max-reduce over
+    the (P, Lq) query rows held in VMEM (only one lane matches ``i - 1``,
+    so max == select): code and weight are pre-packed per lane as
+    ``weight << 3 | code`` (codes are 0..4, weights integral 0..93), so
+    the dominant per-step O(Lq) scan runs once, not twice.
     """
     W = band
     c = W // 2
@@ -688,19 +731,22 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
     nn = n_ref[:, :]
     mm = m_ref[:, :]
     bg = bg_ref[:, :]
-    # i32 views for the per-step selects (Mosaic only reduces i32/f32)
-    qcv = qc_ref[:, :].astype(jnp.int32)   # (P, Lq)
-    qwv = qw_ref[:, :].astype(jnp.int32)
+    # packed i32 view for the per-step select (Mosaic only reduces
+    # i32/f32): weight<<3 | code per lane, one reduce recovers both
+    qpw = ((qw_ref[:, :].astype(jnp.int32) << 3)
+           | qc_ref[:, :].astype(jnp.int32))   # (P, Lq)
     lane_ww = lax.broadcasted_iota(jnp.int32, (P, WW), 1)
     lane_q = lax.broadcasted_iota(jnp.int32, (P, Lq), 1)
     chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
                                    P=P, C=C, RB=RB, S=S)
 
-    def blank_row(off):
+    def blank_group(g):
+        off = pl.multiple_of(g * C, 128)
         idx_ref[:, pl.ds(off, C)] = jnp.full((P, C), VOT, jnp.int32)
         w_ref[:, pl.ds(off, C)] = jnp.zeros((P, C), jnp.uint8)
 
-    k0 = _walk_start(nn, mm, chunk_dma, blank_row, S=S, C=C, CHUNKS=CHUNKS)
+    k0 = _walk_start(nn, mm, chunk_dma, blank_group, S=S, C=C,
+                     CHUNKS=CHUNKS)
     zrow = jnp.minimum(nn, 0)
     ibuf0 = jnp.full((P, 128), VOT, jnp.int32) + zrow
     wbuf0 = jnp.zeros((P, 128), jnp.int32) + zrow
@@ -727,8 +773,10 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
             # layer base code + weight at query position i-1 (clipped like
             # the XLA path; a single lane matches, so max == select)
             qmask = lane_q == jnp.clip(i - 1, 0, Lq - 1)
-            base = jnp.max(jnp.where(qmask, qcv, 0), axis=1, keepdims=True)
-            wq = jnp.max(jnp.where(qmask, qwv, 0), axis=1, keepdims=True)
+            sel_pw = jnp.max(jnp.where(qmask, qpw, 0), axis=1,
+                             keepdims=True)
+            base = sel_pw & 7
+            wq = sel_pw >> 3
 
             slot_i = jnp.minimum(run, K - 1)
             col = bg + j - 1

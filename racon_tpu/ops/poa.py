@@ -31,15 +31,21 @@ one-block-per-group graph POA, consensus is computed as a
 4. the emitted consensus becomes the next round's backbone **on device**:
    ``refine_round`` rebuilds the backbone rows (the emitted entries
    compact to their prefix-sum positions) and remaps every layer span
-   through the emitted-column map; ``refine_loop`` runs all ``rounds``
-   rounds in ONE dispatch — the host packs once, dispatches once and
-   fetches once per group (the tunnel costs ~0.1-0.3 s per round-trip,
-   which used to dominate wall-clock). Windows whose backbone reproduces
+   through the emitted-column map; ``refine_loop`` runs a stage's rounds
+   in ONE dispatch — the host packs once, dispatches once and fetches
+   once per stage (the tunnel costs ~0.1-0.3 s per round-trip, which
+   used to dominate wall-clock). Windows whose backbone reproduces
    itself byte-for-byte are **converged**: their layers stop realigning
    (n = m = 0 pairs, which the Pallas kernels' per-block dynamic bounds
-   skip nearly for free) — on real data ~97% of windows converge within
-   2-3 rounds, cutting the device loop ~2.6x; every recorded golden is a
-   true fixed point and is unchanged by the gating.
+   skip nearly for free), the loop exits early once every window is
+   converged or frozen, and after ``STAGE_A_ROUNDS`` a mostly-converged
+   group re-packs its few stragglers ~25x smaller for the remaining
+   rounds (clean high-coverage windows reach their fixed point in ~2
+   rounds; noisy real windows often never reproduce byte-exactly, so a
+   mostly-live group instead continues in place on its device-resident
+   state). Recorded goldens are unchanged by all three mechanisms:
+   converged/frozen windows reject updates, so skipped rounds are
+   provably no-ops.
 
 Like the reference's GPU path, this engine is allowed to differ slightly
 from the CPU spoa-semantics engine (upstream records separate CUDA goldens:
@@ -91,6 +97,17 @@ GROW = 256
 # steady size instead of one monolithic batch; the analog of cudapoa's
 # fixed per-batch memory, cudapolisher.cpp:219-228).
 MAX_GROUP_PAIRS = 8192
+# Refinement rounds run at full group size before the decision point: a
+# group whose windows mostly converged (clean high-coverage data reaches
+# its byte-exact fixed point in ~2 rounds) re-packs the few stragglers
+# into a small stage-B group for the remaining rounds; a group that is
+# mostly still refining (noisy real data rarely hits an exact fixed
+# point) just continues the remaining rounds IN PLACE on its
+# device-resident state — no repack, no re-upload, one extra fetch.
+STAGE_A_ROUNDS = 2
+# Stage-B repack pays a host pack + upload; it wins only when it shrinks
+# the batch a lot. Above this survivor fraction, continue in place.
+STAGE_B_MAX_SURVIVOR_FRAC = 0.5
 # Vote channels: A C G T N DEL (stride 8 for cheap addressing).
 CH = 8
 A, C, G, T, N_CODE, DEL = 0, 1, 2, 3, 4, 5
@@ -261,7 +278,12 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
     under the chosen scoring lose voting power. The match/mismatch/gap
     counts come from the edit score plus a gap count derived from the
     vote stream itself (gaps = insertion votes + DEL column votes), so
-    both walk backends compute identical alphas. At the default scores
+    both walk backends compute identical alphas. The stream-derived gap
+    count is an *approximation*: insertion runs longer than K_INS and
+    insertions outside [0, L) emit no votes, so their gaps are
+    undercounted and mat/mis correspondingly overestimated — alpha is an
+    approximate CLI-score weight (consistently for both backends;
+    defaults are exact since alpha is the constant 64 there). At the default scores
     alpha == 64 exactly for every layer — a uniform scale that cancels
     in every consensus ratio — so default results are bit-identical to
     unweighted voting (backbone votes are pre-scaled by 64 at pack
@@ -458,9 +480,10 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     any round succeeded (false -> CPU fallback), ``frozen`` stop-refining
     flag (backbone outgrew Lb), ``conv`` converged flag (backbone
     reproduced itself; layers stop realigning). ``dropped`` accumulates telemetry
-    counters ([nd, 3] i32: rejected layer alignments, sweep-truncated
-    spans, fold-overflow insertion votes — the last never lose votes,
-    they switch the round to the uncapped scatter). The single source of truth for the round wiring,
+    counters ([nd, 4] i32: rejected layer alignments, sweep-truncated
+    spans, fold-overflow insertion votes — which never lose votes, they
+    switch the round to the uncapped scatter — and executed post-gating
+    wavefront steps). The single source of truth for the round wiring,
     wrapped by :func:`refine_loop` (all rounds in one dispatch) and the
     ``shard_map`` path (``racon_tpu.parallel.sharded_refine_loop``).
     """
@@ -531,11 +554,14 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     # span outgrew the sweep bound (n + m > steps keeps the walk from
     # finishing — a quality cliff distinct from band escapes, ADVICE r3),
     # [2] insertion votes past the fold-compaction cap (not lost — the
-    # round fell back to the uncapped level-1 scatter)
+    # round fell back to the uncapped level-1 scatter), [3] executed
+    # wavefront steps (sum of n+m AFTER convergence gating — the honest
+    # numerator for device-utilization estimates: gated pairs do no DP)
     dropped = dropped + jnp.stack(
         [jnp.sum((~okp) & real),
          jnp.sum(real & (n + m > steps)),
-         ins_ovf])[None, :]
+         ins_ovf,
+         jnp.sum(jnp.where(real, jnp.minimum(n + m, steps), 0))])[None, :]
 
     # ---- rebuild backbone rows from emitted columns/slots.
     # Entry order within a column: its base first, then insertion slots
@@ -621,19 +647,48 @@ def refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
                 scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
     """All refinement rounds of a group in ONE device dispatch.
 
-    ``lax.fori_loop`` over :func:`refine_round` — per-round host
+    ``lax.while_loop`` over :func:`refine_round` — per-round host
     dispatches over the tunnel (~0.1 s each) otherwise rival the device
     time of a round; with the loop on device a group costs one dispatch
-    and one fetch regardless of ``rounds``."""
-    def body(_, state):
-        return refine_round(
-            n, qcodes, qweights, win_of, real, *state, ins_theta, del_beta,
-            n_windows=n_windows, max_len=max_len, band=band, Lb=Lb, K=K,
-            steps=steps, use_pallas=use_pallas, Lq2=Lq2, scores=scores)
+    and one fetch regardless of ``rounds``. The loop **exits early** once
+    every window with real pairs is converged or frozen: further rounds
+    are provably no-ops (converged/frozen windows reject updates via
+    ``ok_upd`` and their gated pairs emit no votes and no telemetry), so
+    the early exit is bit-invisible — it only skips work."""
+    nW_rows = bcodes.shape[0]
+    win_real = (jnp.zeros((nW_rows,), jnp.int32)
+                .at[win_of].max(real.astype(jnp.int32)) > 0)
+
+    def cond(carry):
+        return (carry[0] < rounds) & ~jnp.all(carry[9] | carry[8]
+                                              | ~win_real)
+
+    def body(carry):
+        out = refine_round(
+            n, qcodes, qweights, win_of, real, *carry[1:], ins_theta,
+            del_beta, n_windows=n_windows, max_len=max_len, band=band,
+            Lb=Lb, K=K, steps=steps, use_pallas=use_pallas, Lq2=Lq2,
+            scores=scores)
+        return (carry[0] + 1,) + tuple(out)
 
     state = (bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
              dropped)
-    return lax.fori_loop(0, rounds, body, state)
+    return lax.while_loop(cond, body, (jnp.int32(0),) + state)[1:]
+
+
+@jax.jit
+def _fetch_pack(bcodes, blen, covs, ever, frozen, conv, dropped, bg, ed):
+    """Coalesce a group's fetch into TWO device arrays: the tunnel pays
+    ~0.1 s latency per transfer, so nine per-array fetches per group cost
+    more than the round compute they retrieve. ``mat`` packs coverage and
+    backbone code per column (cov << 3 | code — the same packing the
+    rebuild uses, both values already bounded); ``meta`` concatenates
+    every per-window/per-pair vector."""
+    mat = (covs << 3) | bcodes.astype(jnp.int32)
+    meta = jnp.concatenate([
+        blen, ever.astype(jnp.int32), frozen.astype(jnp.int32),
+        conv.astype(jnp.int32), dropped.reshape(-1), bg, ed])
+    return mat, meta
 
 
 class _Work:
@@ -674,7 +729,13 @@ class TpuPoaConsensus(PallasDispatchMixin):
                  mesh=None, ins_theta: float = 0.25, del_beta: float = 0.65,
                  num_batches: int = 1):
         self.fallback = fallback
-        self.max_depth = max_depth
+        # device ceiling (companion to the K_INS/CH caps in the module
+        # docstring): _accumulate_votes packs each insertion-vote cell as
+        # weight (bits 0-22) + count (bits 23-31) in one u32, so the
+        # per-address count — bounded by the voting depth via the
+        # drop-collapse rule — must fit 9 bits. Deeper requests clamp
+        # here rather than silently carrying between the packed fields.
+        self.max_depth = min(max_depth, 511)
         self.band = band
         self.rounds = rounds
         self.mesh = mesh
@@ -705,9 +766,12 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # is dispatched before the first result is fetched (JAX async
         # dispatch), so host packing overlaps device compute.
         self.num_batches = max(1, num_batches)
+        # wavefront_steps: executed (post-gating) DP anti-diagonal steps,
+        # the honest numerator for utilization estimates (bench.py)
         self.stats = {"device_windows": 0, "fallback_windows": 0,
                       "dropped_layers": 0, "sweep_truncated": 0,
-                      "ins_overflow": 0, "passthrough": 0}
+                      "ins_overflow": 0, "passthrough": 0,
+                      "stage_b_windows": 0, "wavefront_steps": 0}
 
     # -------------------------------------------------------------- public
 
@@ -775,9 +839,16 @@ class TpuPoaConsensus(PallasDispatchMixin):
             self._last_total_units = total_units
             done_units = 0
             inflight = []
+            # two-stage refinement: stage A runs the first STAGE_A_ROUNDS
+            # at full group size; windows still unconverged after it are
+            # re-packed (with their refined backbones and remapped spans)
+            # into far smaller stage-B groups for the remaining rounds
+            survivors = [] if self.rounds > STAGE_A_ROUNDS else None
+            ra = min(self.rounds, STAGE_A_ROUNDS)
             for g in groups:
                 la = self._launch_group(g, Lq, Lb)
                 la["geom"] = (Lq, Lb, steps, Lq2)
+                la["rounds"] = ra
                 self._rounds(la, Lq, Lb, steps, Lq2)
                 done_units += 1
                 if progress is not None:
@@ -788,9 +859,13 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     progress(done_units, total_units)
                 inflight.append(la)
                 if len(inflight) > self.num_batches:
-                    self._finish_group(inflight.pop(0), trim, results)
+                    self._finish_group(inflight.pop(0), trim, results,
+                                       collect=survivors)
             for la in inflight:
-                self._finish_group(la, trim, results)
+                self._finish_group(la, trim, results, collect=survivors)
+            if survivors:
+                self._run_stage_b(survivors, trim, results,
+                                  Lq, Lb, steps, Lq2)
 
         cpu_idx = [i for i, r in enumerate(results) if r is None]
         if cpu_idx:
@@ -810,11 +885,15 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
     # -------------------------------------------------------------- device
 
-    def _pack_shard(self, items, Lq, B, nWp, Lb):
+    def _pack_shard(self, items, Lq, B, nWp, Lb, overrides=None):
         """Pack one shard's windows into fixed-shape pair/window arrays.
 
         ``items`` is a list of ``(result_index, _Work)``; pair rows beyond
         the shard's real pairs vote into the sink window ``nWp - 1``.
+        ``overrides`` (stage-B repack) maps a result index to that
+        window's fetched stage-A state ``(bcodes_row, blen, covs_row,
+        ever, bg_per_layer, ed_per_layer)`` so the window resumes from
+        its refined backbone and remapped spans instead of restarting.
         """
         n = np.ones(B, np.int32)
         qcodes = np.zeros((B, Lq), np.uint8)
@@ -863,6 +942,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
         bcodes = np.zeros((nWp, Lb), np.uint8)
         bweights = np.zeros((nWp, Lb), np.float32)
         blen = np.zeros(nWp, np.int32)
+        covs = np.zeros((nWp, Lb), np.int32)
+        ever = np.zeros(nWp, bool)
         for wi, (_, w) in enumerate(items):
             bb = w.backbone
             bcodes[wi, :len(bb)] = _CODE_LUT[np.frombuffer(bb, np.uint8)]
@@ -874,13 +955,32 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     - 33.0)
             blen[wi] = len(bb)
 
-        return (n, qcodes, qweights, win_of, real, bg, ed), \
-               (bcodes, bweights, blen)
+        if overrides:
+            off = 0
+            for wi, (ri, w) in enumerate(items):
+                kw = len(w.layers)
+                st = overrides.get(ri)
+                if st is not None:
+                    st_bc, st_bl, st_cov, st_ever, st_bg, st_ed = st
+                    bcodes[wi] = st_bc
+                    blen[wi] = st_bl
+                    covs[wi] = st_cov
+                    ever[wi] = st_ever
+                    if st_ever:
+                        # a refined backbone carries no phred
+                        bweights[wi] = 0.0
+                    bg[off:off + kw] = st_bg
+                    ed[off:off + kw] = st_ed
+                off += kw
 
-    def _launch_group(self, live, Lq, Lb):
+        return (n, qcodes, qweights, win_of, real, bg, ed), \
+               (bcodes, bweights, blen, covs, ever)
+
+    def _launch_group(self, live, Lq, Lb, overrides=None):
         """Pack one window group (per-mesh-shard when a mesh is set — pairs
         of a window never cross shards, so votes stay shard-local) into the
-        device-resident refinement state."""
+        device-resident refinement state. ``overrides`` carries fetched
+        stage-A state for a stage-B repack (see :meth:`_pack_shard`)."""
         from ..parallel import mesh_size, partition_balanced
         nd = mesh_size(self.mesh)
         if nd == 1:
@@ -898,11 +998,12 @@ class TpuPoaConsensus(PallasDispatchMixin):
         while nWp < max_wins + 1:
             nWp *= 2
 
-        packs = [self._pack_shard(sh, Lq, B, nWp, Lb) for sh in shards]
+        packs = [self._pack_shard(sh, Lq, B, nWp, Lb, overrides)
+                 for sh in shards]
         pair_np = [np.concatenate([p[0][a] for p in packs])
                    for a in range(7)]
         win_np = [np.concatenate([p[1][a] for p in packs])
-                  for a in range(3)]
+                  for a in range(5)]
         # single-host: plain device puts; multi-host: every process packs
         # the (deterministic) full arrays and materializes only its
         # addressable shards of the global array
@@ -911,18 +1012,17 @@ class TpuPoaConsensus(PallasDispatchMixin):
                else jnp.asarray)
         static = tuple(put(a) for a in pair_np[:5])   # n..real
         bg, ed = (put(pair_np[5]), put(pair_np[6]))
-        bcodes, bweights, blen = (put(a) for a in win_np)
+        bcodes, bweights, blen, covs, ever = (put(a) for a in win_np)
         zput = (lambda a: put(np.asarray(a)))
-        covs = zput(np.zeros((nd * nWp, Lb), np.int32))
-        ever = zput(np.zeros(nd * nWp, bool))
         frozen = zput(np.zeros(nd * nWp, bool))
         conv = zput(np.zeros(nd * nWp, bool))
-        # telemetry row per shard: [dropped, sweep-truncated, ins-overflow]
-        dropped = zput(np.zeros((nd, 3), np.int32))
+        # telemetry row per shard: [dropped, sweep-truncated, ins-overflow,
+        # executed wavefront steps]
+        dropped = zput(np.zeros((nd, 4), np.int32))
         state = [bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
                  dropped]
         return {"shards": shards, "static": static, "state": state,
-                "nWp": nWp, "nd": nd}
+                "nWp": nWp, "nd": nd, "B": B, "overrides": overrides}
 
     def _rounds(self, launch, Lq, Lb, steps, Lq2=0) -> None:
         """Dispatch a group's full refinement loop (no host sync).
@@ -946,26 +1046,75 @@ class TpuPoaConsensus(PallasDispatchMixin):
     def _dispatch_rounds(self, launch, Lq, Lb, steps, Lq2,
                          use_pallas) -> None:
         static, state = launch["static"], launch["state"]
+        rounds = launch.get("rounds", self.rounds)
         theta = jnp.float32(self.ins_theta)
         beta = jnp.float32(self.del_beta)
         if launch["nd"] == 1:
             out = refine_loop(
-                *static, *state, theta, beta, rounds=self.rounds,
+                *static, *state, theta, beta, rounds=rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=self.band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 Lq2=Lq2, scores=self.scores)
         else:
             from ..parallel import sharded_refine_loop
             out = sharded_refine_loop(
-                self.mesh, static, state, theta, beta, rounds=self.rounds,
+                self.mesh, static, state, theta, beta, rounds=rounds,
                 n_windows_local=launch["nWp"], max_len=Lq, band=self.band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 Lq2=Lq2, scores=self.scores)
         launch["state"] = list(out)
+        if launch["nd"] == 1:
+            # coalesced two-array fetch (single-device only: the packed
+            # concat would force cross-shard gathers under a mesh)
+            (bg, ed, bcodes, _, blen, covs, ever, frozen, conv,
+             dropped) = out
+            launch["fetch2"] = _fetch_pack(bcodes, blen, covs, ever,
+                                           frozen, conv, dropped, bg, ed)
+
+    def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
+                     Lq2) -> None:
+        """Remaining rounds for the stage-A stragglers, re-packed small.
+
+        ``survivors`` is ``[(result_index, work, fetched_state), ...]``
+        collected by :meth:`_finish_group` across ALL stage-A groups, so
+        the handful of unconverged windows of a big run coalesce into one
+        (or few) groups — B and n_windows shrink by the convergence
+        factor (~30x on real data) while rounds 4+ compute the identical
+        per-window fixed points (windows are independent; the vote
+        accumulation is exact integer arithmetic at any batch size)."""
+        rb = self.rounds - STAGE_A_ROUNDS
+        live = [(i, w) for i, w, _ in survivors]
+        overrides = {i: st for i, _, st in survivors}
+        self.stats["stage_b_windows"] += len(live)
+        total_pairs = sum(len(w.layers) for _, w in live)
+        n_groups = max(1, -(-total_pairs // MAX_GROUP_PAIRS))
+        if n_groups == 1:
+            groups = [live]
+        else:
+            from ..parallel import partition_balanced
+            bins = partition_balanced([len(w.layers) for _, w in live],
+                                      n_groups)
+            groups = [[live[i] for i in b] for b in bins if b]
+        inflight = []
+        for g in groups:
+            la = self._launch_group(g, Lq, Lb, overrides=overrides)
+            la["geom"] = (Lq, Lb, steps, Lq2)
+            la["rounds"] = rb
+            self._rounds(la, Lq, Lb, steps, Lq2)
+            inflight.append(la)
+            if len(inflight) > self.num_batches:
+                self._finish_group(inflight.pop(0), trim, results)
+        for la in inflight:
+            self._finish_group(la, trim, results)
 
     def _finish_group(self, launch, trim: bool, results,
-                      retried: bool = False) -> None:
+                      retried: bool = False, collect=None) -> None:
         """One host fetch per group; decode consensus bytes + trim.
+
+        With ``collect`` (a list — stage A of a two-stage run), windows
+        that are neither converged nor frozen are NOT decoded: their
+        fetched state is appended to ``collect`` for the stage-B repack
+        and their result stays pending.
 
         JAX dispatch is async, so a Pallas *runtime* fault (a DMA/VMEM
         fault on the real chip that the compile-time probe could not see)
@@ -973,31 +1122,97 @@ class TpuPoaConsensus(PallasDispatchMixin):
         group on the XLA kernels instead of aborting the polish
         (ADVICE r3)."""
         shards, nWp = launch["shards"], launch["nWp"]
-        # fetch only what the stitch needs (bg/ed/bweights/frozen stay on
-        # device — every transferred byte rides the slow tunnel)
-        (_, _, bcodes, _, blen, covs, ever, _, _,
-         dropped) = launch["state"]
+        # single-device groups fetch TWO coalesced arrays (_fetch_pack —
+        # per-transfer tunnel latency dominates the bytes); mesh groups
+        # fetch per array (bweights always stays on device)
         from ..parallel import fetch_global
         try:
-            bcodes, blen, covs, ever, dropped = fetch_global(
-                [bcodes, blen, covs, ever, dropped])
+            if "fetch2" in launch:
+                mat, meta = fetch_global(list(launch["fetch2"]))
+            else:
+                (bg_d, ed_d, bcodes, _, blen, covs, ever, frozen, conv,
+                 dropped) = launch["state"]
+                fetch = [bcodes, blen, covs, ever, dropped]
+                if collect is not None:  # straggler-resume state
+                    fetch += [frozen, conv, bg_d, ed_d]
+                fetched = fetch_global(fetch)
         except Exception as e:
             Lq, Lb, steps, Lq2 = launch["geom"]
             if retried:
                 raise
             self._note_pallas_failure((Lq, self.band, steps, Lb, Lq2), e)
             live = [item for sh in shards for item in sh]
-            relaunch = self._launch_group(live, Lq, Lb)
+            relaunch = self._launch_group(live, Lq, Lb,
+                                          overrides=launch["overrides"])
             relaunch["geom"] = launch["geom"]
+            # a stage-B repack resumes from its override state with the
+            # remaining rounds; a stage-A (or continued-in-place) group
+            # relaunches from the ORIGINAL backbones, so it must re-run
+            # the FULL round budget and decode directly — handing it to
+            # a second stage would double-refine, truncating would
+            # under-refine
+            if launch["overrides"] is not None:
+                relaunch["rounds"] = launch.get("rounds", self.rounds)
+            else:
+                relaunch["rounds"] = self.rounds
+                collect = None
             self._rounds(relaunch, Lq, Lb, steps, Lq2)
-            self._finish_group(relaunch, trim, results, retried=True)
+            self._finish_group(relaunch, trim, results, retried=True,
+                               collect=collect)
             return
+        if "fetch2" in launch:
+            nWr = launch["nd"] * nWp
+            nd4 = launch["nd"] * 4
+            B_all = launch["nd"] * launch["B"]
+            bcodes = (mat & 7).astype(np.uint8)
+            covs = mat >> 3
+            offs = np.cumsum([nWr, nWr, nWr, nWr, nd4, B_all])
+            blen, ever, frozen_h, conv_h, dropped, bg_h, ed_h = \
+                np.split(meta, offs)
+            ever = ever.astype(bool)
+            dropped = dropped.reshape(launch["nd"], 4)
+        else:
+            bcodes, blen, covs, ever, dropped = fetched[:5]
+            if collect is not None:
+                frozen_h, conv_h, bg_h, ed_h = fetched[5:]
+        if collect is not None:
+            # decision point: repack the stragglers only when few survive;
+            # a mostly-unconverged group (noisy data rarely reaches an
+            # exact fixed point) continues its remaining rounds on the
+            # state already resident on device — no repack, no re-upload
+            n_real = sum(len(sh) for sh in shards)
+            n_surv = 0
+            for s, sh in enumerate(shards):
+                for wi in range(len(sh)):
+                    row = s * nWp + wi
+                    if not conv_h[row] and not frozen_h[row]:
+                        n_surv += 1
+            if n_surv > STAGE_B_MAX_SURVIVOR_FRAC * n_real:
+                Lq, Lb, steps, Lq2 = launch["geom"]
+                launch["rounds"] = self.rounds - STAGE_A_ROUNDS
+                self._rounds(launch, Lq, Lb, steps, Lq2)
+                self._finish_group(launch, trim, results, retried=retried,
+                                   collect=None)
+                return
         self.stats["dropped_layers"] += int(dropped[:, 0].sum())
         self.stats["sweep_truncated"] += int(dropped[:, 1].sum())
         self.stats["ins_overflow"] += int(dropped[:, 2].sum())
+        self.stats["wavefront_steps"] += int(dropped[:, 3].sum())
+        B = launch["B"]
         for s, sh in enumerate(shards):
+            off = 0  # pair-row offset within this shard's pack
             for wi, (i, w) in enumerate(sh):
                 row = s * nWp + wi
+                kw = len(w.layers)
+                p0 = s * B + off
+                off += kw
+                if (collect is not None and not conv_h[row]
+                        and not frozen_h[row]):
+                    collect.append((i, w, (
+                        bcodes[row].copy(), int(blen[row]),
+                        covs[row].copy(), bool(ever[row]),
+                        bg_h[p0:p0 + kw].copy(), ed_h[p0:p0 + kw].copy())))
+                    continue
                 if not ever[row]:
                     results[i] = None  # no successful round -> CPU fallback
                     continue
