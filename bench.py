@@ -164,48 +164,73 @@ def bench_aligner():
     }
 
 
+def build_stress_windows(mbp: float, seed: int = 17):
+    """Stress-shaped window set (VERDICT r4 #6): mixed lengths 250-1000,
+    depths 3..400 (the 200 voting cap and the <3-layer passthrough both
+    fire), a slice of oversized layers (device rejects -> CPU fallback)
+    and a low-identity slice — so the scale number is earned on a
+    workload where the reject/fallback telemetry is non-zero, not on
+    uniform best-case windows."""
+    import numpy as np
+    from racon_tpu.core.window import Window, WindowType
+
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    windows = []
+    covered = 0
+    wi = 0
+    while covered < mbp * 1e6:
+        wl = int(rng.integers(250, 1001))
+        covered += wl
+        kind = wi % 50
+        if kind == 47:       # passthrough: fewer than 3 sequences
+            depth = 1
+        elif kind == 48:     # beyond the 200-layer voting cap
+            depth = int(rng.integers(250, 400))
+        elif kind == 49:     # oversized layers: device reject -> CPU
+            depth = 8
+        else:
+            depth = int(rng.integers(3, 60))
+        truth = bases[rng.integers(0, 4, wl)]
+        bb = truth.copy()
+        flips = rng.random(wl) < 0.10
+        bb[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * wl)
+        err = 0.30 if kind == 46 else 0.08   # one low-identity slice
+        nindel = max(2, wl // 40)
+        for _ in range(depth):
+            layer = truth.copy()
+            flips = rng.random(wl) < err
+            layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+            layer = np.delete(layer, rng.integers(0, len(layer), nindel))
+            ins_n = nindel if kind != 49 else 3 * wl  # blow past Lq
+            layer = np.insert(layer, rng.integers(0, len(layer), ins_n),
+                              bases[rng.integers(0, 4, ins_n)])
+            win.add_layer(layer.tobytes(), b"9" * len(layer), 0, wl - 1)
+        windows.append(win)
+        wi += 1
+    return windows
+
+
 def bench_scale():
     """Scaling probe, on by default (RACON_TPU_BENCH_SCALE overrides the
-    size in Mbp; 0 disables): consensus throughput on a synthetic
-    ONT-like genome at ~30x — ~2,000 windows / 1 Mbp, the regime where
-    fixed dispatch cost amortizes away and the BASELINE.md metrics
-    (Mbp polished/s, device utilization) are meaningful. The headline
-    JSON reports these as scale_* plus the consensus_vpu_util_est."""
+    size in Mbp; 0 disables): consensus throughput on a STRESS-shaped
+    synthetic window set (mixed lengths/depths, rejects firing — see
+    :func:`build_stress_windows`), with a measured CPU-engine baseline
+    on the same windows for an apples-to-apples ``scale_vs_cpu``."""
     import os
 
     mbp = float(os.environ.get("RACON_TPU_BENCH_SCALE", "1") or 0)
     if not mbp:
         return {}
-    import numpy as np
-    from racon_tpu.core.window import Window, WindowType
     from racon_tpu.core.backends import CpuPoaConsensus
     from racon_tpu.ops.poa import TpuPoaConsensus
 
-    rng = np.random.default_rng(17)
-    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
-    n_windows = int(mbp * 1e6) // 500
-    windows = []
-    for wi in range(n_windows):
-        truth = bases[rng.integers(0, 4, 500)]
-        bb = truth.copy()
-        flips = rng.random(500) < 0.10
-        bb[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
-        win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * 500)
-        for _ in range(30):
-            layer = truth.copy()
-            flips = rng.random(500) < 0.08
-            layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
-            layer = np.delete(layer, rng.integers(0, len(layer), 12))
-            ins_at = rng.integers(0, len(layer), 12)
-            layer = np.insert(layer, ins_at,
-                              bases[rng.integers(0, 4, 12)])
-            win.add_layer(layer.tobytes(), b"9" * len(layer), 0, 499)
-        windows.append(win)
-
-    tpu = TpuPoaConsensus(3, -5, -4,
-                          fallback=CpuPoaConsensus(3, -5, -4, 8),
-                          num_batches=2)
-    log(f"scale probe: {n_windows} windows ({mbp} Mbp at 30x), cold...")
+    windows = build_stress_windows(mbp)
+    n_windows = len(windows)
+    cpu = CpuPoaConsensus(3, -5, -4, 8)
+    tpu = TpuPoaConsensus(3, -5, -4, fallback=cpu, num_batches=2)
+    log(f"scale probe: {n_windows} stress windows ({mbp} Mbp), cold...")
     t0 = time.perf_counter()
     tpu.run(windows, trim=True)
     cold = time.perf_counter() - t0
@@ -214,6 +239,18 @@ def bench_scale():
     t0 = time.perf_counter()
     tpu.run(windows, trim=True)
     warm = time.perf_counter() - t0
+    # the stress shapes must actually exercise the reject contract (the
+    # stress kinds recur every 50 windows, so tiny override sizes may
+    # legitimately not contain them)
+    if n_windows >= 100:
+        assert tpu.stats["fallback_windows"] > 0, tpu.stats
+        assert tpu.stats["dropped_layers"] > 0, tpu.stats
+        assert tpu.stats["passthrough"] > 0, tpu.stats
+    log("scale CPU baseline on the same windows...")
+    t0 = time.perf_counter()
+    cpu.run(windows, trim=True)
+    cpu_t = time.perf_counter() - t0
+    log(f"scale cpu: {cpu_t:.2f}s ({mbp / cpu_t:.3f} Mbp/s)")
     log(f"scale warm: {warm:.2f}s ({n_windows / warm:.1f} windows/s, "
         f"{mbp / warm:.3f} Mbp/s)")
     # device-utilization estimate at scale: EXECUTED DP lane-updates
@@ -232,39 +269,146 @@ def bench_scale():
         "scale_windows": n_windows,
         "scale_windows_per_sec": round(n_windows / warm, 2),
         "scale_mbp_per_sec": round(mbp / warm, 4),
+        "scale_cpu_s": round(cpu_t, 3),
+        "scale_cpu_mbp_per_sec": round(mbp / cpu_t, 4),
+        "scale_vs_cpu": round(cpu_t / warm, 3),
         "consensus_vpu_util_est": round(vpu_util, 4),
         "scale_stats": dict(tpu.stats),
     }
 
 
+def bench_pipeline():
+    """FULL-pipeline benchmark at assembly scale (VERDICT r4 #1), on by
+    default: parse -> device align/breaking-points -> window -> device
+    consensus -> stitch on a >=10 Mbp simulated ONT assembly (reads at
+    30x + exact PAF overlaps + a ~10%-error draft; tools/simulate.py),
+    through the exact create_polisher/initialize/polish surface the CLI
+    drives. A 1 Mbp slice runs the identical pipeline on the CPU engines
+    for a measured per-Mbp baseline. Quality gate: the polished draft
+    must land much closer to the truth than the input draft (checked on
+    a 100 kbp prefix with the native Myers distance).
+    RACON_TPU_BENCH_PIPELINE overrides the size in Mbp; 0 disables."""
+    import os
+    import sys
+    import tempfile
+    import time as _time
+
+    mbp = float(os.environ.get("RACON_TPU_BENCH_PIPELINE", "10") or 0)
+    if not mbp:
+        return {}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from simulate import simulate
+    from racon_tpu.core.polisher import create_polisher
+    from racon_tpu import native
+
+    def run_once(mbp_run, seed, backend, batches):
+        t0 = _time.perf_counter()
+        reads, paf, contigs, truths = simulate(mbp_run, seed=seed)
+        gen_s = _time.perf_counter() - t0
+        with tempfile.TemporaryDirectory() as td:
+            rp = os.path.join(td, "reads.fastq")
+            pp = os.path.join(td, "ovl.paf")
+            cp = os.path.join(td, "draft.fasta")
+            for path, blob in ((rp, reads), (pp, paf), (cp, contigs)):
+                with open(path, "wb") as f:
+                    f.write(blob)
+            t0 = _time.perf_counter()
+            p = create_polisher(rp, pp, cp, num_threads=8,
+                                aligner_backend=backend,
+                                consensus_backend=backend,
+                                aligner_batches=batches,
+                                consensus_batches=batches)
+            p.initialize()
+            init_s = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            polished = p.polish(drop_unpolished_sequences=True)
+            polish_s = _time.perf_counter() - t0
+        stats = {}
+        for eng in (p.aligner, p.consensus):
+            for k, v in getattr(eng, "stats", {}).items():
+                stats[k] = stats.get(k, 0) + v
+        # quality gate on a truth-prefix slice (coordinates drift with
+        # indels, so compare a bounded prefix with the full Myers NW)
+        probe = min(100_000, len(truths[0]))
+        pol0 = next((s.data for s in polished
+                     if s.name.startswith(b"contig_0")), b"")
+        draft0 = contigs.split(b"\n", 1)[1].split(b"\n", 1)[0]
+        err_after = native.edit_distance(pol0[:probe], truths[0][:probe])
+        err_before = native.edit_distance(draft0[:probe],
+                                          truths[0][:probe])
+        return dict(gen_s=gen_s, init_s=init_s, polish_s=polish_s,
+                    total_s=init_s + polish_s, stats=stats,
+                    err_after=err_after, err_before=err_before,
+                    probe=probe, n_polished=len(polished))
+
+    log(f"pipeline bench: {mbp} Mbp TPU full pipeline...")
+    tpu = run_once(mbp, seed=23, backend="tpu", batches=2)
+    log(f"pipeline tpu: init {tpu['init_s']:.1f}s + polish "
+        f"{tpu['polish_s']:.1f}s = {tpu['total_s']:.1f}s "
+        f"({mbp / tpu['total_s']:.3f} Mbp/s), stats={tpu['stats']}")
+    cpu_mbp = min(1.0, mbp)
+    log(f"pipeline bench: {cpu_mbp} Mbp CPU-engine baseline...")
+    cpu = run_once(cpu_mbp, seed=29, backend="cpu", batches=1)
+    log(f"pipeline cpu: {cpu['total_s']:.1f}s "
+        f"({cpu_mbp / cpu['total_s']:.3f} Mbp/s)")
+    assert cpu["err_after"] * 3 < cpu["err_before"], cpu
+    assert tpu["err_after"] * 3 < tpu["err_before"], tpu
+    tput = mbp / tpu["total_s"]
+    cput = cpu_mbp / cpu["total_s"]
+    return {
+        "pipeline_mbp": mbp,
+        "pipeline_total_s": round(tpu["total_s"], 2),
+        "pipeline_init_s": round(tpu["init_s"], 2),
+        "pipeline_polish_s": round(tpu["polish_s"], 2),
+        "pipeline_mbp_per_sec": round(tput, 4),
+        "pipeline_cpu_mbp": cpu_mbp,
+        "pipeline_cpu_total_s": round(cpu["total_s"], 2),
+        "pipeline_cpu_mbp_per_sec": round(cput, 4),
+        "pipeline_vs_cpu": round(tput / cput, 3),
+        "pipeline_err_per_100k_before": tpu["err_before"],
+        "pipeline_err_per_100k_after": tpu["err_after"],
+        "pipeline_stats": tpu["stats"],
+    }
+
+
 def bench_parse():
     """Ingest throughput (VERDICT r3: parse must stay <10% of wall at
-    >=100 Mbp inputs): ~100 MB of concatenated λ-phage FASTQ through the
-    native zlib parser. Gzipped inputs bottom out at zlib's serial
-    inflate (~40 MB/s — the reference's vendored bioparser shares that
-    floor), so the probe measures the parser itself on plain bytes."""
+    >=100 Mbp inputs): ~100 MB of concatenated λ-phage FASTQ and ~100 MB
+    of concatenated real PAF through the native parsers. Gzipped inputs
+    bottom out at zlib's serial inflate (~40 MB/s — the reference's
+    vendored bioparser shares that floor), so the probes measure the
+    parsers themselves on plain bytes."""
     import gzip
     import os
     import tempfile
 
-    raw = gzip.open(f"{DATA}/sample_reads.fastq.gz").read()
-    n = max(1, 100_000_000 // len(raw))
-    from racon_tpu.io.parsers import parse_fastq
-    with tempfile.NamedTemporaryFile(suffix=".fastq", delete=False) as f:
-        for _ in range(n):
-            f.write(raw)
-        path = f.name
-    try:
-        size = os.path.getsize(path)
-        t0 = time.perf_counter()
-        records = list(parse_fastq(path))
-        dt = time.perf_counter() - t0
-    finally:
-        os.unlink(path)
-    rate = size / dt / 1e6
-    log(f"parse: {len(records)} records, {size / 1e6:.0f} MB in "
-        f"{dt:.2f}s = {rate:.0f} MB/s")
-    return {"parse_mb_per_sec": round(rate, 1)}
+    from racon_tpu.io.parsers import parse_fastq, parse_paf
+
+    out = {}
+    for label, src, parser, suffix in (
+            ("parse_mb_per_sec", f"{DATA}/sample_reads.fastq.gz",
+             parse_fastq, ".fastq"),
+            ("parse_paf_mb_per_sec", f"{DATA}/sample_ava_overlaps.paf.gz",
+             parse_paf, ".paf")):
+        raw = gzip.open(src).read()
+        n = max(1, 100_000_000 // len(raw))
+        with tempfile.NamedTemporaryFile(suffix=suffix, delete=False) as f:
+            for _ in range(n):
+                f.write(raw)
+            path = f.name
+        try:
+            size = os.path.getsize(path)
+            t0 = time.perf_counter()
+            records = list(parser(path))
+            dt = time.perf_counter() - t0
+        finally:
+            os.unlink(path)
+        rate = size / dt / 1e6
+        log(f"parse {suffix}: {len(records)} records, {size / 1e6:.0f} MB "
+            f"in {dt:.2f}s = {rate:.0f} MB/s")
+        out[label] = round(rate, 1)
+    return out
 
 
 def main():
@@ -279,6 +423,7 @@ def main():
     cold, warm, cpu_t, stats = bench_consensus(windows)
     aligner_metrics = bench_aligner()
     scale_metrics = bench_scale()
+    pipeline_metrics = bench_pipeline()
     parse_metrics = bench_parse()
 
     total_bases = sum(len(w.sequences[0]) for w in windows)
@@ -295,6 +440,7 @@ def main():
         "consensus_stats": stats,
         **aligner_metrics,
         **scale_metrics,  # scale_mbp_per_sec + consensus_vpu_util_est
+        **pipeline_metrics,  # full-pipeline Mbp/s + CPU baseline
         **parse_metrics,
         "device": str(jax.devices()[0]),
     }

@@ -1,11 +1,11 @@
 """Streaming FASTA/FASTQ/MHAP/PAF/SAM parsers with transparent gzip.
 
 Role-equivalent of the reference's vendored ``bioparser`` library (used via
-``bioparser::createParser`` at ``src/polisher.cpp:83-133``). FASTA/FASTQ
-ingest runs through the native zlib parser when the C++ core is built
-(``native/parsers.cpp``, >100 MB/s; the Python loops below are the
-fallback and the behavioural oracle — ``tests/test_parsers.py`` asserts
-record-for-record equality). Matches bioparser's observable behaviour:
+``bioparser::createParser`` at ``src/polisher.cpp:83-133``). ALL five
+formats run through the native parser when the C++ core is built
+(``native/parsers.cpp``; the Python loops below are the fallback and the
+behavioural oracle — ``tests/test_parsers.py`` asserts record-for-record
+equality). Matches bioparser's observable behaviour:
 
 - names are truncated at the first whitespace character;
 - FASTA/FASTQ records may span multiple lines;
@@ -74,11 +74,16 @@ def _native_records(path: str, is_fastq: bool):
     return [SequenceRecord(n, d, q) for n, d, q in recs]
 
 
-def parse_fasta(path: str) -> Iterator[SequenceRecord]:
+def parse_fasta(path: str):
+    """Iterable of SequenceRecords (a materialized list on the native
+    fast path — avoids 1 generator hop per record on huge files)."""
     recs = _native_records(path, False)
     if recs is not None:
-        yield from recs
-        return
+        return recs
+    return _parse_fasta_py(path)
+
+
+def _parse_fasta_py(path: str) -> Iterator[SequenceRecord]:
     name = None
     chunks: list = []
     with open_maybe_gzip(path) as f:
@@ -97,13 +102,16 @@ def parse_fasta(path: str) -> Iterator[SequenceRecord]:
             yield SequenceRecord(name, b"".join(chunks))
 
 
-def parse_fastq(path: str) -> Iterator[SequenceRecord]:
+def parse_fastq(path: str):
     """Multi-line-tolerant FASTQ: sequence lines until '+', then quality bytes
     until their length matches the sequence length."""
     recs = _native_records(path, True)
     if recs is not None:
-        yield from recs
-        return
+        return recs
+    return _parse_fastq_py(path)
+
+
+def _parse_fastq_py(path: str) -> Iterator[SequenceRecord]:
     with open_maybe_gzip(path) as f:
         it = iter(f)
         for raw in it:
@@ -136,8 +144,29 @@ def parse_fastq(path: str) -> Iterator[SequenceRecord]:
             yield SequenceRecord(name, data, quality)
 
 
-def parse_paf(path: str) -> Iterator[OverlapRecord]:
+def _native_ovl(path: str, fmt_code: int):
+    """Native overlap parse (same memory tradeoff note as
+    :func:`_native_records`); returns None when the native core is
+    unavailable, else the full record list — already ``.fmt``/
+    ``.fields`` record objects, materialized in C."""
+    from .. import native
+    if not native.available():
+        return None
+    try:
+        return native.parse_ovlfile(path, fmt_code)
+    except native.NativeBuildError:
+        return None
+
+
+def parse_paf(path: str):
     """PAF: qname qlen qstart qend strand tname tlen tstart tend matches alen mapq [tags]."""
+    recs = _native_ovl(path, 0)
+    if recs is not None:
+        return recs
+    return _parse_paf_py(path)
+
+
+def _parse_paf_py(path: str) -> Iterator[OverlapRecord]:
     with open_maybe_gzip(path) as f:
         for raw in f:
             line = raw.rstrip()
@@ -150,9 +179,16 @@ def parse_paf(path: str) -> Iterator[OverlapRecord]:
             ))
 
 
-def parse_mhap(path: str) -> Iterator[OverlapRecord]:
+def parse_mhap(path: str):
     """MHAP: aid bid jaccard shared arc astart aend alen brc bstart bend
     blen (space-separated, 1-based ids)."""
+    recs = _native_ovl(path, 1)
+    if recs is not None:
+        return recs
+    return _parse_mhap_py(path)
+
+
+def _parse_mhap_py(path: str) -> Iterator[OverlapRecord]:
     with open_maybe_gzip(path) as f:
         for raw in f:
             line = raw.rstrip()
@@ -166,8 +202,15 @@ def parse_mhap(path: str) -> Iterator[OverlapRecord]:
             ))
 
 
-def parse_sam(path: str) -> Iterator[OverlapRecord]:
+def parse_sam(path: str):
     """SAM: qname flag rname pos mapq cigar ... (header lines skipped)."""
+    recs = _native_ovl(path, 2)
+    if recs is not None:
+        return recs
+    return _parse_sam_py(path)
+
+
+def _parse_sam_py(path: str) -> Iterator[OverlapRecord]:
     with open_maybe_gzip(path) as f:
         for raw in f:
             if raw.startswith(b"@"):

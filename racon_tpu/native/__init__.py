@@ -14,9 +14,15 @@ import threading
 
 _DIR = pathlib.Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libracon_native.so"
-_SOURCES = sorted(_DIR.glob("*.cpp"))
+_EXT_PATH = _DIR / "racon_native_ext.so"
+# pyext.cpp is the optional CPython extension (needs Python headers) —
+# built separately so the ctypes core never depends on them
+_SOURCES = sorted(s for s in _DIR.glob("*.cpp") if s.name != "pyext.cpp")
+_EXT_SOURCES = [_DIR / "pyext.cpp", _DIR / "parsers.cpp"]
 _lock = threading.Lock()
 _lib = None
+_ext = None
+_ext_tried = False
 
 
 class NativeBuildError(RuntimeError):
@@ -45,6 +51,47 @@ def build(force: bool = False) -> pathlib.Path:
                 raise NativeBuildError(
                     f"native build failed:\n{proc.stderr[-4000:]}")
     return _LIB_PATH
+
+
+def _load_ext():
+    """Build/load the optional CPython extension (fast overlap-record
+    materialization); returns the module or None. Never raises — the
+    ctypes path is the functional fallback."""
+    global _ext, _ext_tried
+    if _ext_tried:
+        return _ext
+    with _lock:
+        if _ext_tried:
+            return _ext
+        _ext_tried = True
+        try:
+            import sysconfig
+
+            newest = max(s.stat().st_mtime for s in _EXT_SOURCES)
+            if not _EXT_PATH.exists() or \
+                    _EXT_PATH.stat().st_mtime < newest:
+                cmd = [
+                    "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                    "-march=native",
+                    f"-I{sysconfig.get_paths()['include']}",
+                    *[str(s) for s in _EXT_SOURCES],
+                    "-o", str(_EXT_PATH), "-lz",
+                ]
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    return None
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader(
+                "racon_native_ext", str(_EXT_PATH))
+            spec = importlib.util.spec_from_loader("racon_native_ext",
+                                                   loader)
+            _ext = importlib.util.module_from_spec(spec)
+            loader.exec_module(_ext)
+        except Exception:
+            _ext = None
+    return _ext
 
 
 def load():
@@ -91,6 +138,11 @@ def load():
         ctypes.c_char_p, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
         ctypes.c_char_p]
+    lib.rt_parse_ovlfile.restype = ctypes.c_int64
+    lib.rt_parse_ovlfile.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_char_p]
     _lib = lib
     return _lib
 
@@ -236,3 +288,64 @@ def parse_seqfile(path: str, is_fastq: bool):
         if n >= 0:
             lib.rt_free(blob)
             lib.rt_free(offs)
+
+
+# per-format (n_strings, n_nums) arity of rt_parse_ovlfile records
+_OVL_ARITY = {0: (2, 7), 1: (0, 12), 2: (3, 2)}
+
+
+def parse_ovlfile(path: str, fmt: int):
+    """Parse a (possibly gzipped) overlap file natively: fmt 0=PAF,
+    1=MHAP, 2=SAM. Returns a list of records with ``.fmt``/``.fields``
+    attributes, the fields identical to the Python oracle parsers'
+    ``OverlapRecord.fields`` (io/parsers.py). Prefers the CPython
+    extension (record materialization in C, >100 MB/s); the ctypes
+    route below is the fallback."""
+    ext = _load_ext()
+    if ext is not None:
+        return ext.parse_ovlfile(path, fmt)
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    blob = ctypes.c_void_p()
+    soffs = ctypes.c_void_p()
+    nums = ctypes.c_void_p()
+    err = ctypes.create_string_buffer(256)
+    n = lib.rt_parse_ovlfile(path.encode(), fmt, ctypes.byref(blob),
+                             ctypes.byref(soffs), ctypes.byref(nums), err)
+    if n < 0:
+        raise ValueError(err.value.decode(errors="replace"))
+    ns, nn = _OVL_ARITY[fmt]
+    from ..io.parsers import OverlapRecord
+    fmt_name = ("paf", "mhap", "sam")[fmt]
+    try:
+        so = ((ctypes.c_int64 * (2 * ns * n)).from_address(soffs.value)
+              if n and ns else [])
+        nu = ((ctypes.c_double * (nn * n)).from_address(nums.value)
+              if n else [])
+        base = blob.value
+        out = []
+        for i in range(n):
+            strs = [ctypes.string_at(base + so[2 * (ns * i + k)],
+                                     so[2 * (ns * i + k) + 1])
+                    for k in range(ns)]
+            num = nu[nn * i: nn * i + nn]
+            if fmt == 0:
+                b = int(num[3])
+                f = (strs[0], int(num[0]), int(num[1]), int(num[2]),
+                     chr(b) if b else "", strs[1], int(num[4]),
+                     int(num[5]), int(num[6]))
+            elif fmt == 1:
+                f = (int(num[0]), int(num[1]), num[2], int(num[3]),
+                     int(num[4]), int(num[5]), int(num[6]),
+                     int(num[7]), int(num[8]), int(num[9]),
+                     int(num[10]), int(num[11]))
+            else:
+                f = (strs[0], int(num[0]), strs[1], int(num[1]), strs[2])
+            out.append(OverlapRecord(fmt_name, f))
+        return out
+    finally:
+        if n >= 0:
+            lib.rt_free(blob)
+            lib.rt_free(soffs)
+            lib.rt_free(nums)

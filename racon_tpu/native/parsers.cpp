@@ -81,6 +81,29 @@ struct Out {
 };
 
 bool read_all(const char* path, std::string& buf, char* err) {
+    // plain files skip zlib entirely (gzread still funnels plain bytes
+    // through its own buffering at a measurable cost); gzip is detected
+    // by magic bytes like the Python oracle, not extension
+    FILE* raw = fopen(path, "rb");
+    if (!raw) {
+        snprintf(err, 256, "cannot open %s", path);
+        return false;
+    }
+    unsigned char magic[2] = {0, 0};
+    size_t mg = fread(magic, 1, 2, raw);
+    if (!(mg == 2 && magic[0] == 0x1f && magic[1] == 0x8b)) {
+        fseek(raw, 0, SEEK_END);
+        long sz = ftell(raw);
+        fseek(raw, 0, SEEK_SET);
+        if (sz > 0) {
+            buf.resize((size_t)sz);
+            size_t got = fread(&buf[0], 1, (size_t)sz, raw);
+            buf.resize(got);
+        }
+        fclose(raw);
+        return true;
+    }
+    fclose(raw);
     gzFile f = gzopen(path, "rb");
     if (!f) {
         snprintf(err, 256, "cannot open %s", path);
@@ -188,6 +211,151 @@ int64_t rt_parse_seqfile(const char* path, int32_t is_fastq,
     *blob_out = blob;
     *offs_out = offs;
     return (int64_t)(out.offs.size() / 6);
+}
+
+// Parse a (possibly gzipped) overlap file: fmt 0=PAF, 1=MHAP, 2=SAM.
+// Line-oriented memchr scanning, the overlap-side analog of
+// rt_parse_seqfile (reference routes all five formats through native
+// bioparser, src/polisher.cpp:83-133). Per record the outputs hold:
+//   PAF:  strings [qname, tname];        nums [qlen, qstart, qend,
+//         strand_byte, tlen, tstart, tend]                      (2, 7)
+//   MHAP: strings [];                    nums [aid, bid, jaccard,
+//         shared, arc, astart, aend, alen, brc, bstart, bend, blen]
+//                                                               (0, 12)
+//   SAM:  strings [qname, rname, cigar]; nums [flag, pos]       (3, 2)
+// nums travel as double (every integer field is < 2^53, so exact); the
+// jaccard double equals Python float() on the same token (both
+// correctly rounded). Strings land in *blob_out with (off, len) pairs
+// in *soffs_out. Header (@) and empty lines are skipped for SAM, empty
+// lines for all. Returns the record count or -1 with err[256] set.
+int64_t rt_parse_ovlfile(const char* path, int32_t fmt, char** blob_out,
+                         int64_t** soffs_out, double** nums_out,
+                         char* err) {
+    std::string buf;
+    if (!read_all(path, buf, err)) return -1;
+
+    std::string blob;
+    std::vector<int64_t> soffs;
+    std::vector<double> nums;
+    size_t pos = 0, b = 0, e = 0;
+    std::vector<std::pair<size_t, size_t>> tok;
+    int64_t count = 0;
+
+    while (next_line(buf, &pos, &b, &e)) {
+        if (b == e) continue;
+        if (fmt == 2 && buf[b] == '@') continue;
+        tok.clear();
+        if (fmt == 1) {  // whitespace split
+            size_t i = b;
+            while (i < e) {
+                while (i < e && is_space(buf[i])) ++i;
+                size_t s = i;
+                while (i < e && !is_space(buf[i])) ++i;
+                if (i > s) tok.emplace_back(s, i);
+            }
+        } else {  // tab split (Python line.split(b"\t"))
+            size_t s = b;
+            for (size_t i = b; i <= e; ++i) {
+                if (i == e || buf[i] == '\t') {
+                    tok.emplace_back(s, i);
+                    s = i + 1;
+                }
+            }
+        }
+        const size_t need = fmt == 0 ? 9 : (fmt == 1 ? 12 : 6);
+        if (tok.size() < need) {
+            snprintf(err, 256, "malformed line %lld in %s",
+                     (long long)(count + 1), path);
+            return -1;
+        }
+        bool bad = false;
+        auto num = [&](size_t k) -> double {
+            // integer fields only (every PAF/SAM numeric field, 11 of
+            // MHAP's 12): inline decimal parse — strtod costs ~50
+            // ns/field and dominated the scan; int64 -> double is exact
+            // below 2^53. Python-int semantics: surrounding whitespace
+            // and one leading sign allowed, anything else (empty,
+            // non-digit) marks the line malformed like the oracle's
+            // int() raising.
+            const char* p = buf.data() + tok[k].first;
+            const char* e2 = buf.data() + tok[k].second;
+            while (p < e2 && is_space(*p)) ++p;
+            while (e2 > p && is_space(e2[-1])) --e2;
+            bool neg = p < e2 && *p == '-';
+            if (p < e2 && (*p == '-' || *p == '+')) ++p;
+            int64_t v = 0;
+            const char* d = p;
+            while (d < e2 && *d >= '0' && *d <= '9') v = v * 10 + (*d++ - '0');
+            if (d == e2 && d > p) return neg ? -(double)v : (double)v;
+            bad = true;
+            return 0.0;
+        };
+        auto fnum = [&](size_t k) -> double {
+            // float field (MHAP jaccard): bounded strtod on a
+            // null-terminated copy of the token
+            size_t len = tok[k].second - tok[k].first;
+            char tmp[64];
+            if (len == 0 || len >= sizeof(tmp)) {
+                bad = true;
+                return 0.0;
+            }
+            std::memcpy(tmp, buf.data() + tok[k].first, len);
+            tmp[len] = '\0';
+            char* endp = nullptr;
+            double v = strtod(tmp, &endp);
+            if (endp != tmp + len) bad = true;
+            return v;
+        };
+        auto str = [&](size_t k) {
+            soffs.push_back((int64_t)blob.size());
+            soffs.push_back((int64_t)(tok[k].second - tok[k].first));
+            blob.append(buf, tok[k].first, tok[k].second - tok[k].first);
+        };
+        if (fmt == 0) {
+            str(0); str(5);
+            nums.push_back(num(1)); nums.push_back(num(2));
+            nums.push_back(num(3));
+            // first byte of the strand token (0 when empty — Python's
+            // t[4][:1] is b"" there)
+            nums.push_back(tok[4].second > tok[4].first
+                           ? (double)(unsigned char)buf[tok[4].first]
+                           : 0.0);
+            nums.push_back(num(6)); nums.push_back(num(7));
+            nums.push_back(num(8));
+        } else if (fmt == 1) {
+            for (size_t k = 0; k < 12; ++k) {
+                nums.push_back(k == 2 ? fnum(k) : num(k));
+            }
+        } else {
+            str(0); str(2); str(5);
+            nums.push_back(num(1)); nums.push_back(num(3));
+        }
+        if (bad) {
+            snprintf(err, 256, "malformed line %lld in %s",
+                     (long long)(count + 1), path);
+            return -1;
+        }
+        ++count;
+    }
+
+    buf.clear();
+    buf.shrink_to_fit();
+    char* bl = (char*)std::malloc(blob.size() + 1);
+    int64_t* so = (int64_t*)std::malloc(soffs.size() * sizeof(int64_t) + 8);
+    double* nu = (double*)std::malloc(nums.size() * sizeof(double) + 8);
+    if (!bl || !so || !nu) {
+        std::free(bl); std::free(so); std::free(nu);
+        snprintf(err, 256, "out of memory parsing %s", path);
+        return -1;
+    }
+    std::memcpy(bl, blob.data(), blob.size());
+    bl[blob.size()] = '\0';
+    std::memcpy(so, soffs.data(), soffs.size() * sizeof(int64_t));
+    std::memcpy(nu, nums.data(), nums.size() * sizeof(double));
+    *blob_out = bl;
+    *soffs_out = so;
+    *nums_out = nu;
+    return count;
 }
 
 }  // extern "C"

@@ -114,3 +114,67 @@ def test_native_parser_skips_leading_header_whitespace(tmp_path):
     f.write_bytes(b">  ctg1 extra\nACGT\n")
     (rec,) = native.parse_seqfile(str(f), False)
     assert rec[0] == b"ctg1" and rec[1] == b"ACGT"
+
+
+def test_native_ovl_parser_matches_python_oracle(data_dir):
+    """The native overlap parser (PAF/MHAP/SAM) must produce field
+    tuples identical to the Python oracle parsers on the real λ files,
+    including the float jaccard (both are correctly-rounded doubles of
+    the same token) and the SAM header skip."""
+    import racon_tpu.io.parsers as P
+    from racon_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+
+    import unittest.mock as mock
+    for fname, fmt, parser in (
+            ("sample_overlaps.paf.gz", 0, P.parse_paf),
+            ("sample_ava_overlaps.paf.gz", 0, P.parse_paf),
+            ("sample_ava_overlaps.mhap.gz", 1, P.parse_mhap),
+            ("sample_overlaps.sam.gz", 2, P.parse_sam)):
+        path = str(data_dir / fname)
+        got = native.parse_ovlfile(path, fmt)
+        with mock.patch.object(P, "_native_ovl", lambda *a: None):
+            want = list(parser(path))
+        assert len(got) == len(want)
+        assert [r.fields for r in got] == [r.fields for r in want]
+        assert all(g.fmt == w.fmt for g, w in zip(got, want))
+
+
+def test_native_ovl_parser_rejects_malformed(tmp_path):
+    from racon_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    bad = tmp_path / "bad.paf"
+    bad.write_bytes(b"q1\t100\t0\t100\n")  # too few fields
+    import pytest
+    with pytest.raises(ValueError, match="malformed line 1"):
+        native.parse_ovlfile(str(bad), 0)
+
+
+def test_ctypes_ovl_fallback_matches_oracle(data_dir):
+    """The ctypes record-reconstruction path (used when the CPython
+    extension cannot build) must match the oracle too."""
+    import unittest.mock as mock
+    import racon_tpu.io.parsers as P
+    from racon_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+
+    with mock.patch.object(native, "_load_ext", lambda: None):
+        for fname, fmt, parser in (
+                ("sample_overlaps.paf.gz", 0, P.parse_paf),
+                ("sample_ava_overlaps.mhap.gz", 1, P.parse_mhap),
+                ("sample_overlaps.sam.gz", 2, P.parse_sam)):
+            path = str(data_dir / fname)
+            got = native.parse_ovlfile(path, fmt)
+            with mock.patch.object(P, "_native_ovl", lambda *a: None):
+                want = list(parser(path))
+            assert [r.fields for r in got] == [r.fields for r in want]
+            assert all(g.fmt == w.fmt for g, w in zip(got, want))
