@@ -203,7 +203,10 @@ def build_stress_windows(mbp: float, seed: int = 17):
             flips = rng.random(wl) < err
             layer[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
             layer = np.delete(layer, rng.integers(0, len(layer), nindel))
-            ins_n = nindel if kind != 49 else 3 * wl  # blow past Lq
+            # kind 49 blows past the pair buffer Lq for EVERY window
+            # length (Lq <= max_window + band = ~1.5k), so those windows
+            # are deterministic device rejects
+            ins_n = nindel if kind != 49 else 3500
             layer = np.insert(layer, rng.integers(0, len(layer), ins_n),
                               bases[rng.integers(0, 4, ins_n)])
             win.add_layer(layer.tobytes(), b"9" * len(layer), 0, wl - 1)
@@ -262,7 +265,7 @@ def bench_scale():
     # uncounted, so this is a lower bound on busy-ness but an honest
     # count of useful alignment work per wall-second.
     from racon_tpu.ops.poa import BAND
-    cells = tpu.stats["wavefront_steps"] * (BAND // 2)
+    cells = tpu.stats["wavefront_steps"] * (tpu.stats.get("band", BAND) // 2)
     vpu_util = cells * 20 / warm / (8 * 128 * 2 * 0.94e9)
     return {
         "scale_mbp": mbp,

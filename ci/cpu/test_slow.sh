@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Slow-golden suite (VERDICT r4 #5): CI asserts every recorded golden —
+# the 10 device goldens, the 2-process multihost byte-equality run, the
+# NGS e2e, fragment correction, and the stress-scale reject contract.
+# The gated tests are independent, so they split into four shards that
+# each stay within a ~8 min budget on the CPU mesh; run a single shard
+# with `test_slow.sh 1|2|3|4`, or everything with no argument.
+set -e
+cd "$(dirname "$0")/../.."
+shard="${1:-all}"
+run() { RACON_TPU_SLOW=1 python -m pytest "$@" -q; }
+# device-golden scenarios, first half (quality/banded/format matrix)
+if [ "$shard" = 1 ] || [ "$shard" = all ]; then
+  run tests/test_pipeline.py \
+    -k "not (w1000 or unit_scores or e2e_scores or fasta_sam or fastq_sam)"
+fi
+# device-golden scenarios, second half (scores + remaining formats)
+if [ "$shard" = 2 ] || [ "$shard" = all ]; then
+  run tests/test_pipeline.py \
+    -k "w1000 or unit_scores or e2e_scores or fasta_sam or fastq_sam"
+fi
+if [ "$shard" = 3 ] || [ "$shard" = all ]; then
+  run tests/test_fragment_correction.py tests/test_multihost.py
+fi
+if [ "$shard" = 4 ] || [ "$shard" = all ]; then
+  run tests/test_ngs.py tests/test_scale_stress.py
+fi
+echo "slow goldens ($shard): OK"

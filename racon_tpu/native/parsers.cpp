@@ -22,6 +22,7 @@
 #include <string>
 #include <vector>
 
+#include <sys/stat.h>
 #include <zlib.h>
 
 namespace {
@@ -81,29 +82,35 @@ struct Out {
 };
 
 bool read_all(const char* path, std::string& buf, char* err) {
-    // plain files skip zlib entirely (gzread still funnels plain bytes
-    // through its own buffering at a measurable cost); gzip is detected
-    // by magic bytes like the Python oracle, not extension
-    FILE* raw = fopen(path, "rb");
-    if (!raw) {
-        snprintf(err, 256, "cannot open %s", path);
-        return false;
-    }
-    unsigned char magic[2] = {0, 0};
-    size_t mg = fread(magic, 1, 2, raw);
-    if (!(mg == 2 && magic[0] == 0x1f && magic[1] == 0x8b)) {
-        fseek(raw, 0, SEEK_END);
-        long sz = ftell(raw);
-        fseek(raw, 0, SEEK_SET);
-        if (sz > 0) {
-            buf.resize((size_t)sz);
-            size_t got = fread(&buf[0], 1, (size_t)sz, raw);
-            buf.resize(got);
+    // plain REGULAR files skip zlib entirely (gzread still funnels plain
+    // bytes through its own buffering at a measurable cost); gzip is
+    // detected by magic bytes like the Python oracle, not extension.
+    // Pipes/FIFOs/other non-regular inputs go straight to the gz path
+    // WITHOUT any probing read (consumed probe bytes cannot be given
+    // back to a pipe) — zlib's transparent mode streams any readable fd.
+    struct stat st;
+    if (stat(path, &st) == 0 && S_ISREG(st.st_mode)) {
+        FILE* raw = fopen(path, "rb");
+        if (!raw) {
+            snprintf(err, 256, "cannot open %s", path);
+            return false;
         }
-        fclose(raw);
-        return true;
+        long sz = -1;
+        if (fseek(raw, 0, SEEK_END) == 0) sz = ftell(raw);
+        if (sz >= 0 && fseek(raw, 0, SEEK_SET) == 0) {
+            buf.resize((size_t)sz);
+            size_t got = sz ? fread(&buf[0], 1, (size_t)sz, raw) : 0;
+            buf.resize(got);
+            fclose(raw);
+            if (!(got >= 2 && (unsigned char)buf[0] == 0x1f &&
+                  (unsigned char)buf[1] == 0x8b)) {
+                return true;  // plain bytes, already fully read
+            }
+            buf.clear();  // gzip magic: re-read through zlib below
+        } else {
+            fclose(raw);
+        }
     }
-    fclose(raw);
     gzFile f = gzopen(path, "rb");
     if (!f) {
         snprintf(err, 256, "cannot open %s", path);

@@ -129,6 +129,13 @@ PyObject* py_parse_ovlfile(PyObject*, PyObject* args) {
             PyTuple_SET_ITEM(t, 4, PyBytes_FromStringAndSize(
                 blob + s[4], (Py_ssize_t)s[5]));
         }
+        // one check covers every unchecked item allocation above: an
+        // allocation failure sets MemoryError and leaves a NULL in the
+        // tuple, which tuple_dealloc tolerates (Py_XDECREF)
+        if (PyErr_Occurred()) {
+            Py_DECREF(t);
+            goto fail_list;
+        }
         PyObject* rec = PyStructSequence_New(g_rec_type);
         if (!rec) {
             Py_DECREF(t);

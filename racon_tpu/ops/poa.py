@@ -793,14 +793,24 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
         if live:
             max_bb = max(len(w.backbone) for _, w in live)
+            # the alignment band scales with the window length (cudapoa's
+            # banded width is proportional to its matrix size too): a
+            # fixed 512-lane band caps acceptable per-layer edits at 256,
+            # which w>=1000 windows at ONT divergence routinely exceed —
+            # those layers' alignments were dropped wholesale, the r4
+            # w=1000 quality cliff (device 2591 vs CPU 1289 with ~1.2k
+            # dropped alignments). Identity for <=512 bp windows, so
+            # every recorded w=500 golden is untouched.
+            band = min(self.band * -(-max_bb // 512), 4096)
             # device ceiling: the packed insertion payload holds
             # addr << 13 in an int32, so Lb*K_INS*CH must fit 18 bits
             # (Lb <= 8192); longer backbones take the CPU fallback like
             # any other reject
             max_dev_L = (1 << 18) // (K_INS * CH) - GROW
             L = max(256, min(-(-max_bb // 256) * 256, max_dev_L))
-            Lq = L + self.band
+            Lq = L + band
             Lb = min(L + GROW, Lq)  # backbone buffer (span fit: Lb <= Lq)
+            self.stats["band"] = band
             # windows whose layers exceed the pair buffer (or backbones the
             # backbone buffer) go to the CPU fallback via results[i] None
             live = [(i, w) for i, w in live
@@ -848,6 +858,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             for g in groups:
                 la = self._launch_group(g, Lq, Lb)
                 la["geom"] = (Lq, Lb, steps, Lq2)
+                la["band"] = band
                 la["rounds"] = ra
                 self._rounds(la, Lq, Lb, steps, Lq2)
                 done_units += 1
@@ -865,7 +876,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 self._finish_group(la, trim, results, collect=survivors)
             if survivors:
                 self._run_stage_b(survivors, trim, results,
-                                  Lq, Lb, steps, Lq2)
+                                  Lq, Lb, steps, Lq2, band)
 
         cpu_idx = [i for i, r in enumerate(results) if r is None]
         if cpu_idx:
@@ -1034,7 +1045,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         instead of aborting the polish (jit compilation is eager, so
         only compile errors are catchable here; numerics are covered by
         the probe's bit-exact comparison)."""
-        shape_key = (Lq, self.band, steps, Lb, Lq2)
+        shape_key = (Lq, launch.get("band", self.band), steps, Lb, Lq2)
         if self._use_pallas(shape_key):
             try:
                 self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, True)
@@ -1047,19 +1058,20 @@ class TpuPoaConsensus(PallasDispatchMixin):
                          use_pallas) -> None:
         static, state = launch["static"], launch["state"]
         rounds = launch.get("rounds", self.rounds)
+        band = launch.get("band", self.band)
         theta = jnp.float32(self.ins_theta)
         beta = jnp.float32(self.del_beta)
         if launch["nd"] == 1:
             out = refine_loop(
                 *static, *state, theta, beta, rounds=rounds,
-                n_windows=launch["nWp"], max_len=Lq, band=self.band,
+                n_windows=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 Lq2=Lq2, scores=self.scores)
         else:
             from ..parallel import sharded_refine_loop
             out = sharded_refine_loop(
                 self.mesh, static, state, theta, beta, rounds=rounds,
-                n_windows_local=launch["nWp"], max_len=Lq, band=self.band,
+                n_windows_local=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 Lq2=Lq2, scores=self.scores)
         launch["state"] = list(out)
@@ -1072,7 +1084,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                                            frozen, conv, dropped, bg, ed)
 
     def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
-                     Lq2) -> None:
+                     Lq2, band) -> None:
         """Remaining rounds for the stage-A stragglers, re-packed small.
 
         ``survivors`` is ``[(result_index, work, fetched_state), ...]``
@@ -1099,6 +1111,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         for g in groups:
             la = self._launch_group(g, Lq, Lb, overrides=overrides)
             la["geom"] = (Lq, Lb, steps, Lq2)
+            la["band"] = band
             la["rounds"] = rb
             self._rounds(la, Lq, Lb, steps, Lq2)
             inflight.append(la)
@@ -1140,11 +1153,13 @@ class TpuPoaConsensus(PallasDispatchMixin):
             Lq, Lb, steps, Lq2 = launch["geom"]
             if retried:
                 raise
-            self._note_pallas_failure((Lq, self.band, steps, Lb, Lq2), e)
+            self._note_pallas_failure(
+                (Lq, launch.get("band", self.band), steps, Lb, Lq2), e)
             live = [item for sh in shards for item in sh]
             relaunch = self._launch_group(live, Lq, Lb,
                                           overrides=launch["overrides"])
             relaunch["geom"] = launch["geom"]
+            relaunch["band"] = launch.get("band", self.band)
             # a stage-B repack resumes from its override state with the
             # remaining rounds; a stage-A (or continued-in-place) group
             # relaunches from the ORIGINAL backbones, so it must re-run

@@ -229,9 +229,11 @@ def test_device_consensus_w1000(data_dir):
                                 "sample_overlaps.paf.gz",
                                 window_length=1000)
     d = rc_distance_to_reference(data_dir, polished)
-    # wider windows cost the pileup engine accuracy the same way banded
-    # cudapoa degrades at w=1000 (reference CUDA: 4168 vs its CPU 1289)
-    assert d == 2591  # device golden
+    # the alignment band scales with window length (r5): w=1000 layers
+    # align inside a 1024 band with zero drops, closing the r4 cliff
+    # (was 2591) to near-CPU quality — reference CUDA degrades to 4168
+    # at banded/w1000 vs its CPU 1289
+    assert d == 1350  # device golden
 
 
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
