@@ -95,21 +95,22 @@ bool read_all(const char* path, std::string& buf, char* err) {
             snprintf(err, 256, "cannot open %s", path);
             return false;
         }
+        // regular files are seekable, so probe the 2 magic bytes and
+        // rewind — gzipped inputs then go straight to zlib without a
+        // wasted raw slurp of the compressed bytes
+        unsigned char magic[2] = {0, 0};
+        size_t mg = fread(magic, 1, 2, raw);
+        bool is_gz = mg == 2 && magic[0] == 0x1f && magic[1] == 0x8b;
         long sz = -1;
-        if (fseek(raw, 0, SEEK_END) == 0) sz = ftell(raw);
-        if (sz >= 0 && fseek(raw, 0, SEEK_SET) == 0) {
+        if (!is_gz && fseek(raw, 0, SEEK_END) == 0) sz = ftell(raw);
+        if (!is_gz && sz >= 0 && fseek(raw, 0, SEEK_SET) == 0) {
             buf.resize((size_t)sz);
             size_t got = sz ? fread(&buf[0], 1, (size_t)sz, raw) : 0;
             buf.resize(got);
             fclose(raw);
-            if (!(got >= 2 && (unsigned char)buf[0] == 0x1f &&
-                  (unsigned char)buf[1] == 0x8b)) {
-                return true;  // plain bytes, already fully read
-            }
-            buf.clear();  // gzip magic: re-read through zlib below
-        } else {
-            fclose(raw);
+            return true;  // plain bytes, fully read
         }
+        fclose(raw);
     }
     gzFile f = gzopen(path, "rb");
     if (!f) {

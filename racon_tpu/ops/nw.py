@@ -49,11 +49,12 @@ TYPICAL_DIVERGENCE = 0.25
 # Upper bound on the packed direction-matrix bytes held across in-flight
 # device batches (v5e has 16 GiB HBM; the matrix never leaves the
 # device). Small caps fragment long-bucket batches into many chunks and
-# each chunk pays a dispatch round-trip; huge chunks coarsen the
-# pack/transfer/compute pipeline overlap — 2 GiB with steps-accurate
-# per-pair accounting keeps 2-8 kbp overlap batches in a handful of
-# chunks either way.
-MAX_DIRS_BYTES = 2 * 1024 * 1024 * 1024
+# each chunk pays a dispatch round-trip over the jittery tunnel (up to
+# ~1 s at bad times — it, not the DP, bounds real runs); huge chunks
+# coarsen the pack/transfer/compute pipeline overlap. 8 GiB across the
+# pipeline depth keeps per-chunk matrices at ~2 GiB even 4-deep, i.e.
+# ~500 ONT read pairs per launch.
+MAX_DIRS_BYTES = 8 * 1024 * 1024 * 1024
 
 @functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
 def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
@@ -304,14 +305,18 @@ def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
 
 
 def _sweep_bound(max_nm: int, max_len: int) -> int:
-    """Anti-diagonal sweep bound for a bucket/chunk: the longest real pair
-    rounded coarsely (1024 for long buckets, so per-chunk shapes stay
-    compile-cache-friendly), capped at the full sweep, multiple of 512
+    """Anti-diagonal sweep bound for a bucket/chunk, multiple of 512
     (the Pallas kernels' granularity: every band's flush period
     F = FL/RB divides 128 and the packed walk flushes 128-byte output
-    groups of 512 steps). Shared by the chunk launcher and the
+    groups of 512 steps). Long buckets quantize to 2048: every distinct
+    ``steps`` value is a separate XLA/Mosaic compile (~30 s) and a
+    longest-first chunk stream over a real read set walks through a
+    handful of them, while the static bound only sizes the direction
+    matrix — the kernels' per-block dynamic bounds already skip the
+    quantization's dead wavefronts, so the coarse quantum costs memory
+    (<= 1 MB/pair), not compute. Shared by the chunk launcher and the
     memory-budget sizing so they account identically."""
-    quant = 512 if max_len <= 1024 else 1024
+    quant = 512 if max_len <= 1024 else 2048
     steps = min(-(-max_nm // quant) * quant, 2 * max_len)
     return -(-steps // 512) * 512
 

@@ -95,8 +95,21 @@ GROW = 256
 # Pairs per device group: larger window sets split into several groups
 # dispatched in flight (keeps per-launch arrays and the vote scatter at a
 # steady size instead of one monolithic batch; the analog of cudapoa's
-# fixed per-batch memory, cudapolisher.cpp:219-228).
-MAX_GROUP_PAIRS = 8192
+# fixed per-batch memory, cudapolisher.cpp:219-228). 16k pairs/group:
+# every group costs a host fetch round trip over the (jittery, up to
+# ~1 s) tunnel, which at 8k/group rivaled the group's own device time;
+# the vote accumulation's MXU matmul grows with B x n_windows but stays
+# well under the round-trip cost it buys back.
+MAX_GROUP_PAIRS = 32768
+# In-flight ceiling for dispatched-but-unfetched groups: each holds its
+# packed inputs (~(2*Lq + ~20) bytes/pair) plus a small output state on
+# device (the big per-round intermediates live only inside the one
+# execution running at a time). The tunnel charges ~0.5-1.3 s per
+# EXECUTION and per fetch — at assembly scale those round trips, not
+# the DP, bound wall-clock — so groups are as large as the vote stream
+# affords and as many as this budget affords are dispatched before the
+# first fetch blocks; the user's -c pipeline depth acts as a floor.
+MAX_INFLIGHT_BYTES = 4 * 1024 * 1024 * 1024
 # Refinement rounds run at full group size before the decision point: a
 # group whose windows mostly converged (clean high-coverage data reaches
 # its byte-exact fixed point in ~2 rounds) re-packs the few stragglers
@@ -691,6 +704,21 @@ def _fetch_pack(bcodes, blen, covs, ever, frozen, conv, dropped, bg, ed):
     return mat, meta
 
 
+@functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
+                                             "max_len", "band", "Lb", "K",
+                                             "steps", "use_pallas",
+                                             "Lq2", "scores"))
+def _refine_loop_packed(*args, **kw):
+    """refine_loop + the coalesced-fetch packing in ONE jitted program:
+    the tunnel charges ~0.5-1.3 s per dispatched execution, so running
+    the packing as a second program doubled the per-group overhead."""
+    out = refine_loop(*args, **kw)
+    (bg, ed, bcodes, _, blen, covs, ever, frozen, conv, dropped) = out
+    mat, meta = _fetch_pack(bcodes, blen, covs, ever, frozen, conv,
+                            dropped, bg, ed)
+    return out + (mat, meta)
+
+
 class _Work:
     """Per-window packing view (layers capped at ``max_depth``)."""
 
@@ -841,10 +869,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 bins = partition_balanced([len(w.layers) for _, w in live],
                                           n_groups)
                 groups = [[live[i] for i in b] for b in bins if b]
-            # bounded pipeline: at most num_batches+1 groups live on
-            # device at once (launch group k+1, then fetch group
-            # k-num_batches), so peak HBM is per-group, like cudapoa's
-            # fixed per-batch memory (cudapolisher.cpp:219-228)
+            # bounded pipeline: at most inflight_cap+1 groups'
+            # inputs/state live on device at once (launch group k+1,
+            # then fetch the oldest once the cap is exceeded); the big
+            # per-round intermediates exist only inside the single
+            # executing program — the MAX_INFLIGHT_BYTES budget is the
+            # analog of cudapoa's fixed per-batch memory
+            # (cudapolisher.cpp:219-228), sized for the tunnel's
+            # per-round-trip latency instead of GPU RAM
             total_units = len(groups) + 1
             self._last_total_units = total_units
             done_units = 0
@@ -852,9 +884,28 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # two-stage refinement: stage A runs the first STAGE_A_ROUNDS
             # at full group size; windows still unconverged after it are
             # re-packed (with their refined backbones and remapped spans)
-            # into far smaller stage-B groups for the remaining rounds
-            survivors = [] if self.rounds > STAGE_A_ROUNDS else None
-            ra = min(self.rounds, STAGE_A_ROUNDS)
+            # into far smaller stage-B groups for the remaining rounds.
+            # Single-group runs skip the split: a lone group's stage-B
+            # launch cannot coalesce anything, so the split only adds a
+            # tunnel round trip there — the monolithic dispatch with the
+            # in-loop early exit is strictly better.
+            two_stage = self.rounds > STAGE_A_ROUNDS and len(groups) > 1
+            survivors = [] if two_stage else None
+            ra = min(self.rounds, STAGE_A_ROUNDS) if two_stage \
+                else self.rounds
+            # per-launch resident bytes: packed pair inputs PLUS the
+            # per-window state and coalesced-fetch arrays each
+            # un-fetched launch pins (bcodes u8 + covs/mat i32 +
+            # bweights f32 ~ 13 bytes per backbone column, padded to
+            # the worst group's power-of-two window count)
+            max_wins = max(len(g) for g in groups)
+            nWp_max = 1
+            while nWp_max < max_wins + 1:
+                nWp_max *= 2
+            group_bytes = ((2 * Lq + 24) * MAX_GROUP_PAIRS
+                           + 16 * Lb * nWp_max)
+            inflight_cap = max(self.num_batches,
+                               MAX_INFLIGHT_BYTES // max(group_bytes, 1))
             for g in groups:
                 la = self._launch_group(g, Lq, Lb)
                 la["geom"] = (Lq, Lb, steps, Lq2)
@@ -869,7 +920,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     # this engine exists to avoid)
                     progress(done_units, total_units)
                 inflight.append(la)
-                if len(inflight) > self.num_batches:
+                if len(inflight) > inflight_cap:
                     self._finish_group(inflight.pop(0), trim, results,
                                        collect=survivors)
             for la in inflight:
@@ -1062,11 +1113,16 @@ class TpuPoaConsensus(PallasDispatchMixin):
         theta = jnp.float32(self.ins_theta)
         beta = jnp.float32(self.del_beta)
         if launch["nd"] == 1:
-            out = refine_loop(
+            # single execution: rounds + the coalesced-fetch packing
+            # (single-device only: the packed concat would force
+            # cross-shard gathers under a mesh)
+            out = _refine_loop_packed(
                 *static, *state, theta, beta, rounds=rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 Lq2=Lq2, scores=self.scores)
+            launch["state"] = list(out[:10])
+            launch["fetch2"] = out[10:12]
         else:
             from ..parallel import sharded_refine_loop
             out = sharded_refine_loop(
@@ -1074,14 +1130,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 n_windows_local=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 Lq2=Lq2, scores=self.scores)
-        launch["state"] = list(out)
-        if launch["nd"] == 1:
-            # coalesced two-array fetch (single-device only: the packed
-            # concat would force cross-shard gathers under a mesh)
-            (bg, ed, bcodes, _, blen, covs, ever, frozen, conv,
-             dropped) = out
-            launch["fetch2"] = _fetch_pack(bcodes, blen, covs, ever,
-                                           frozen, conv, dropped, bg, ed)
+            launch["state"] = list(out)
 
     def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
                      Lq2, band) -> None:

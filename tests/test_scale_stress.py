@@ -1,6 +1,7 @@
 """Stress-shaped scale probe correctness (VERDICT r4 #6): on a window
-set that exercises the device engine's reject contract — mixed lengths
-250-1000, depths 3..400, oversized layers, a low-identity slice — the
+set that exercises the device engine's reject contract — w=500-regime
+lengths (80% exactly 500 bp, 20% shorter tails), depths 3..400,
+oversized layers, a low-identity slice — the
 telemetry must actually fire, and every window the device REJECTS must
 come out byte-identical to a CPU-engine-only polish of the same window
 (the reject path routes through the same fallback engine; reference
