@@ -1,9 +1,13 @@
 #!/usr/bin/env bash
-# CPU test run (analog of ci/cpu/*): full suite on the 8-virtual-device
-# mesh, then the CPU-path CLI golden byte-diff.
+# CPU test run (analog of ci/cpu/*): the fast SWAR kernel-parity shard
+# first (packed-vs-int32 on small shapes, CPU mesh — a packed-path
+# regression fails tier-1 before anything slow runs), then the full
+# suite on the 8-virtual-device mesh, then the CPU-path CLI golden
+# byte-diff.
 set -e
 cd "$(dirname "$0")/../.."
-python -m pytest tests/ -x -q
+python -m pytest tests/test_ops_swar.py -q
+python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py
 DATA=/root/reference/test/data
 python -m racon_tpu -t 8 \
   "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \

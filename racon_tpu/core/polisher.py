@@ -197,6 +197,28 @@ class Polisher:
         log.log("[racon_tpu::Polisher::initialize] loaded overlaps")
         log.log()
 
+        # Kick off background warm-up compilation of the consensus
+        # refinement loop NOW, from the overlap/target histograms: the
+        # first consensus compile (~16 s) then hides inside the device
+        # overlap alignment below instead of stalling polish(). Skipped
+        # for tiny inputs (the compile would outlive the whole run) and
+        # via RACON_TPU_WARMUP=0; a wrong shape estimate only wastes a
+        # background compile (see TpuPoaConsensus.warmup_async).
+        import os as _os
+        warm = getattr(self.consensus, "warmup_async", None)
+        if warm is not None and _os.environ.get("RACON_TPU_WARMUP",
+                                                "1") != "0":
+            est_pairs = sum(o.length // self.window_length + 1
+                            for o in overlaps)
+            targets_bases = sum(len(self.sequences[i].data)
+                                for i in range(self.targets_size))
+            est_windows = targets_bases // self.window_length + \
+                self.targets_size
+            # threshold: below ~16k pairs the whole polish costs less
+            # than the compile the warm-up would race to hide
+            if est_pairs >= 16384:
+                warm(self.window_length, est_pairs, est_windows)
+
         # transmute-parallelism (reference P3: one future per sequence,
         # ``polisher.cpp:368-377``): revcomp materialization is a numpy
         # LUT-take + flip (``sequence.py``), which releases the GIL on

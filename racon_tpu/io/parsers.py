@@ -57,13 +57,12 @@ def _first_token(line: bytes) -> bytes:
 
 
 def _native_records(path: str, is_fastq: bool):
-    # Throughput/memory tradeoff: the native parser reads and inflates the
-    # whole file before tokenizing (peak RSS ~2-3x the decompressed input,
-    # materialized record list included), unlike the Python fallback's
-    # line streaming and the reference bioparser's 1 GiB chunks
-    # (src/polisher.cpp:26). Inputs large enough for that to matter run
-    # through the wrapper's out-of-core split (racon_tpu/wrapper.py),
-    # which bounds per-subprocess input size.
+    # The native parser streams chunked inflate+parse through a bounded
+    # rolling buffer (native/parsers.cpp LineReader — the reference
+    # bioparser's 1 GiB-chunk analog, src/polisher.cpp:26), so peak RSS
+    # is the materialized records plus O(longest line), never the
+    # decompressed input. The wrapper's out-of-core split
+    # (racon_tpu/wrapper.py) additionally bounds the record set itself.
     from .. import native
     if not native.available():
         return None

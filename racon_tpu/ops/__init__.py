@@ -8,6 +8,9 @@
   reference's cudaaligner batches, ``src/cuda/cudaaligner.cpp``).
 - ``racon_tpu.ops.poa`` — device-resident batched POA consensus refinement
   (role of cudapoa, ``src/cuda/cudabatch.cpp``).
+- ``racon_tpu.ops.swar`` — SWAR packed-lane primitives (int16x2 score
+  lanes, 2-bit bases), the bit-exact availability probe and the int16
+  overflow guard shared by both DP kernel families.
 """
 
 import os as _os

@@ -56,9 +56,10 @@ TYPICAL_DIVERGENCE = 0.25
 # ~500 ONT read pairs per launch.
 MAX_DIRS_BYTES = 8 * 1024 * 1024 * 1024
 
-@functools.partial(jax.jit, static_argnames=("max_len", "band", "steps"))
+@functools.partial(jax.jit, static_argnames=("max_len", "band", "steps",
+                                             "swar"))
 def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
-                         steps: int = 0):
+                         steps: int = 0, swar: bool = False):
     """Banded anti-diagonal wavefront DP for one bucket batch.
 
     Coordinate frame: wavefront ``a = i + j`` (scan axis), diagonal
@@ -85,13 +86,30 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
     rounded to 256, cutting the dead wavefronts past the last finish
     (pairs with ``n + m > steps`` never reach their final cell, keep
     score BIG, and are rejected like band escapes).
+
+    ``swar`` runs the SWAR-packed variant: wavefront scores travel as
+    **int16 lanes** — two per 32-bit VPU lane (2x arithmetic density;
+    the vectorizer does the in-register packing) — saturating at
+    ``swar.BIG16`` instead of ``1 << 28``. Every cell value is bounded
+    by ``max_len`` (:func:`swar.swar_fits` is the callers' overflow
+    guard), so the {real, BIG, BIG+1} value classes and hence every
+    direction-code comparison are identical: the direction matrix is
+    **byte-identical** to the int32 path's, and scores are remapped
+    (``BIG16 -> 1 << 28``) so the outputs match bit-for-bit.
     """
     W = band
     c = W // 2
     L = max_len
     U = W // 2  # lanes per wavefront
     S = steps if steps else 2 * L
-    BIG = jnp.int32(1 << 28)
+    if swar:
+        from .swar import BIG16, BIG32
+        assert max_len + 2 < BIG16, (max_len, BIG16)
+        vdt = jnp.int16
+        BIG = jnp.int16(BIG16)
+    else:
+        vdt = jnp.int32
+        BIG = jnp.int32(1 << 28)
 
     us = jnp.arange(U, dtype=jnp.int32)
 
@@ -108,28 +126,31 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
             # shifted views of wavefront a-1 (parity alternates):
             #   p == 0: D-source = v1[u-1], I-source = v1[u]
             #   p == 1: D-source = v1[u],   I-source = v1[u+1]
-            v1_left = jnp.concatenate([jnp.full((1,), BIG, jnp.int32), v1[:-1]])
-            v1_right = jnp.concatenate([v1[1:], jnp.full((1,), BIG, jnp.int32)])
+            v1_left = jnp.concatenate([jnp.full((1,), BIG, vdt), v1[:-1]])
+            v1_right = jnp.concatenate([v1[1:], jnp.full((1,), BIG, vdt)])
             d_src = jnp.where(p == 0, v1_left, v1)
             i_src = jnp.where(p == 0, v1, v1_right)
 
             # characters: q[i-1] and t[j-1] as contiguous slices
             qchars = lax.dynamic_slice_in_dim(qv, c + L - I0, U)
             tchars = lax.dynamic_slice_in_dim(tv, c + J0 - 1, U)
-            sub = jnp.where(qchars == tchars, 0, 1).astype(jnp.int32)
+            sub = jnp.where(qchars == tchars, 0, 1).astype(vdt)
 
             cd = v2 + sub          # diagonal (i-1, j-1)
-            ci = i_src + 1         # consume query (i-1, j)
-            cdel = d_src + 1       # consume target (i, j-1)
+            ci = i_src + vdt(1)    # consume query (i-1, j)
+            cdel = d_src + vdt(1)  # consume target (i, j-1)
             best = jnp.minimum(cd, jnp.minimum(ci, cdel))
             d = jnp.where(cd == best, jnp.uint8(0),
                           jnp.where(ci == best, jnp.uint8(1), jnp.uint8(2)))
 
             interior = (i_vec >= 1) & (i_vec <= nn) & (j_vec >= 1) & (j_vec <= mm)
             v = jnp.where(interior, jnp.minimum(best, BIG), BIG)
-            # boundary rows/cols of the DP table
-            v = jnp.where((i_vec == 0) & (j_vec >= 0) & (j_vec <= mm), j_vec, v)
-            v = jnp.where((j_vec == 0) & (i_vec >= 1) & (i_vec <= nn), i_vec, v)
+            # boundary rows/cols of the DP table (values <= max_len, so
+            # the int16 cast in the packed path is lossless)
+            v = jnp.where((i_vec == 0) & (j_vec >= 0) & (j_vec <= mm),
+                          j_vec.astype(vdt), v)
+            v = jnp.where((j_vec == 0) & (i_vec >= 1) & (i_vec <= nn),
+                          i_vec.astype(vdt), v)
 
             # final score lives at a == n + m, u_final = (m - n + c - p) / 2
             u_fin = (mm - nn + c - p) // 2
@@ -147,12 +168,17 @@ def _nw_wavefront_kernel(qrp, tp, n, m, *, max_len: int, band: int,
         # wavefront 0: only (0,0) at u0 = (c - p0)/2
         p0 = c & 1
         u0 = (c - p0) // 2
-        v0 = jnp.where(us == u0, 0, BIG).astype(jnp.int32)
-        vm1 = jnp.full((U,), BIG, jnp.int32)  # "wavefront -1"
-        score0 = jnp.where(nn + mm == 0, 0, BIG)
+        v0 = jnp.where(us == u0, 0, BIG).astype(vdt)
+        vm1 = jnp.full((U,), BIG, vdt)  # "wavefront -1"
+        score0 = jnp.where(nn + mm == 0, 0, BIG).astype(vdt)
         (v, v1, score), packed = lax.scan(
             step, (v0, vm1, score0),
             jnp.arange(1, S + 1, dtype=jnp.int32))
+        if swar:
+            # restore the int32 saturation constant so consumers (and
+            # the parity harness) see the exact int32-path scores
+            score = jnp.where(score == BIG, jnp.int32(BIG32),
+                              score.astype(jnp.int32))
         return packed, score
 
     return jax.vmap(per_pair)(qrp, tp, n, m)
@@ -232,24 +258,26 @@ def _pack_ops(ops):
 
 
 def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0,
-                use_pallas: bool = False):
+                use_pallas: bool = False, use_swar: bool = False):
     """Wavefront NW + on-device traceback — the single source of truth for
     the aligner's kernel wiring, wrapped unchanged by both the plain path
     (``TpuAligner._run_chunk``) and the ``shard_map`` path
     (``racon_tpu.parallel.sharded_align``). With ``use_pallas`` the
     VMEM-resident Mosaic kernels produce the identical direction matrix
-    and (gap-interleaved) op codes."""
+    and (gap-interleaved) op codes; with ``use_swar`` the forward DP runs
+    on packed int16x2 score lanes (bit-identical outputs — the walks
+    consume the same direction matrix either way)."""
     if use_pallas:
         from .pallas_nw import pallas_nw_fwd, pallas_walk_ops
         packed, score = pallas_nw_fwd(qrp, tp, n, m, max_len=max_len,
                                       band=band, steps=steps,
-                                      out_quant=512)
+                                      out_quant=512, use_swar=use_swar)
         # the Pallas walk emits the packed op stream directly
         ops_packed, fi, fj = pallas_walk_ops(packed, n, m, band=band)
         return ops_packed, score, fi, fj
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                          max_len=max_len, band=band,
-                                         steps=steps)
+                                         steps=steps, swar=use_swar)
     return _traceback_kernel(packed, score, n, m, max_len=max_len, band=band)
 
 
@@ -302,6 +330,28 @@ def _build_rows_packed(q4, t4, n, m, *, max_len: int, band: int):
         return jnp.where(valid, code.astype(jnp.uint8), jnp.uint8(0))
 
     return unpack(q4, qlay), unpack(t4, tlay)
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def _build_rows_packed2(q2, t2, n, m, *, max_len: int, band: int):
+    """``_build_rows`` over 2-bit-packed inputs (four codes per byte, 16
+    per int32 word — the SWAR transfer format for chunks whose alphabet
+    fits 4 symbols). The gathered byte count drops 4x vs raw and 2x vs
+    the nibble pack; code 0 doubles as padding, which is sound because
+    the wavefront kernel only consumes characters at interior cells
+    (pad lanes' direction codes are never read by any walk)."""
+    B = n.shape[0]
+    row0, qlay, tlay = _row_layout(n, m, max_len=max_len, band=band)
+
+    def unpack(cat2, lay):
+        off, valid = lay
+        src = row0 + jnp.clip(off, 0, max_len - 1)
+        w = src.shape[1]
+        byte = jnp.take(cat2, (src // 4).reshape(-1)).reshape(B, w)
+        code = (byte >> ((src % 4) * 2).astype(jnp.uint8)) & 3
+        return jnp.where(valid, code.astype(jnp.uint8), jnp.uint8(0))
+
+    return unpack(q2, qlay), unpack(t2, tlay)
 
 
 def _sweep_bound(max_nm: int, max_len: int) -> int:
@@ -409,7 +459,7 @@ class TpuAligner(PallasDispatchMixin):
 
     def __init__(self, fallback=None, buckets=BUCKETS,
                  max_dirs_bytes=MAX_DIRS_BYTES, mesh=None,
-                 num_batches: int = 1):
+                 num_batches: int = 1, use_swar: bool = True):
         self.fallback = fallback
         self.buckets = buckets
         self.max_dirs_bytes = max_dirs_bytes
@@ -420,8 +470,28 @@ class TpuAligner(PallasDispatchMixin):
         # direction-matrix memory budget, so host packing of chunk k+1
         # overlaps device compute of chunk k.
         self.num_batches = max(1, num_batches)
+        # SWAR-packed forward DP (int16x2 score lanes + 2-bit bases when
+        # the chunk alphabet fits 4 symbols). Guarded per bucket by the
+        # overflow guard (swar.swar_fits) and globally by the bit-exact
+        # availability probe (swar.swar_ok) — both identical-output, so
+        # this knob only exists for A/B measurement and escape hatches.
+        self.use_swar = use_swar
         self.stats = {"device": 0, "fallback_length": 0, "fallback_band": 0,
-                      "band_escalated": 0}
+                      "band_escalated": 0, "swar_chunks": 0,
+                      "swar_guard_int32": 0}
+
+    def _swar_choice(self, max_len: int) -> bool:
+        """Packed-lane eligibility for a bucket: the global availability
+        probe plus the per-bucket overflow guard — a band/length
+        combination whose scores could exceed the int16 saturation
+        ceiling re-dispatches to the int32 path (counted in stats)."""
+        from .swar import swar_fits, swar_ok
+        if not self.use_swar:
+            return False
+        if not swar_fits(max_len):
+            self.stats["swar_guard_int32"] += 1
+            return False
+        return swar_ok()
 
     def _pad_batch(self, count: int) -> int:
         """Batch sizes are ``mesh_size * 2^k`` — always divisible by the
@@ -627,22 +697,33 @@ class TpuAligner(PallasDispatchMixin):
         steps = _sweep_bound(int((n + m).max()), max_len)
 
         # host->device bytes are the bottleneck on thin links: when the
-        # chunk's alphabet fits 15 symbols (ACGTN does), remap each byte
-        # to a 4-bit code (equality-preserving bijection; 0 is padding)
-        # and nibble-pack — halves the transfer, the kernels only ever
-        # compare characters for equality
+        # chunk's alphabet fits 4 symbols (ACGT does) and the SWAR path
+        # is live, remap to 2-bit codes packed 16 per int32 word (4x
+        # fewer bytes than raw); up to 15 symbols (ACGTN does) remap to
+        # nibble codes (2x). Equality-preserving bijections either way —
+        # the kernels only ever compare characters for equality.
         hist = np.bincount(qcat, minlength=256)
         hist += np.bincount(tcat, minlength=256)
         alphabet = np.flatnonzero(hist[1:]) + 1  # O(N), no sort; 0 is pad
+        sw = self._swar_choice(max_len)
         # multi-host: every process packs the (deterministic) chunk and
         # materializes only its addressable shards of the global arrays
         # (the flat char blocks shard evenly too: B is a mesh multiple,
-        # so [B * max_len] splits on row boundaries)
+        # so [B * max_len] splits on row boundaries — max_len is a
+        # multiple of 4, so the 2-bit blocks split evenly as well)
         from ..parallel import to_global
         put = ((lambda a: to_global(self.mesh, a)) if self.mesh is not None
                else jnp.asarray)
         nd, md = put(n), put(m)
-        if len(alphabet) <= 15:
+        if sw and len(alphabet) <= 4:
+            from .swar import pack_bases_2bit
+            lut = np.zeros(256, np.uint8)
+            lut[alphabet] = np.arange(len(alphabet), dtype=np.uint8)
+            qrp, tp = _build_rows_packed2(
+                put(pack_bases_2bit(lut[qcat])),
+                put(pack_bases_2bit(lut[tcat])),
+                nd, md, max_len=max_len, band=band)
+        elif len(alphabet) <= 15:
             lut = np.zeros(256, np.uint8)
             lut[alphabet] = np.arange(1, len(alphabet) + 1, dtype=np.uint8)
             q4 = lut[qcat]
@@ -656,18 +737,45 @@ class TpuAligner(PallasDispatchMixin):
             qrp, tp = _build_rows(put(qcat), put(tcat),
                                   nd, md, max_len=max_len, band=band)
         args = (qrp, tp, nd, md)
-        shape_key = (max_len, band, steps, B)
-        if self._use_pallas(shape_key):
+        base_key = (max_len, band, steps, B)
+        swar_key = base_key + ("swar",)
+        if self._use_pallas(base_key):
+            from .pallas_nw import pallas_swar_ok
+            # the packed Mosaic kernel's XOR+mask equality reads 4-bit
+            # codes, so raw-byte chunks (alphabet > 15, rows not
+            # remapped) must never take it — bytes differing only in
+            # bits 4-7 would compare equal there
+            sw_p = (sw and len(alphabet) <= 15 and pallas_swar_ok()
+                    and self._use_pallas(swar_key))
+            key = swar_key if sw_p else base_key
             try:
-                out = self._dispatch(args, max_len, band, steps, True)
+                out = self._dispatch(args, max_len, band, steps, True,
+                                     sw_p)
                 out = self._attach_bp(out, chunk, pairs, n, m, max_len,
                                       bp_meta, put)
-                return chunk, pairs, n, m, out, (max_len, shape_key)
+                # counted on the path actually taken: the Pallas-level
+                # decision can differ from the XLA-level one
+                self.stats["swar_chunks"] += int(sw_p)
+                return chunk, pairs, n, m, out, (max_len, key)
             except Exception as e:
-                self._note_pallas_failure(shape_key, e)
-        out = self._dispatch(args, max_len, band, steps, False)
+                self._note_pallas_failure(key, e)
+                # a packed-kernel-only fault must not cost the whole
+                # Pallas path: retry the int32 Mosaic kernel before
+                # downgrading the shape to XLA
+                if sw_p and self._use_pallas(base_key):
+                    try:
+                        out = self._dispatch(args, max_len, band, steps,
+                                             True, False)
+                        out = self._attach_bp(out, chunk, pairs, n, m,
+                                              max_len, bp_meta, put)
+                        return chunk, pairs, n, m, out, (max_len,
+                                                         base_key)
+                    except Exception as e2:
+                        self._note_pallas_failure(base_key, e2)
+        out = self._dispatch(args, max_len, band, steps, False, sw)
         out = self._attach_bp(out, chunk, pairs, n, m, max_len, bp_meta,
                               put)
+        self.stats["swar_chunks"] += int(sw)
         return chunk, pairs, n, m, out, (max_len, None)
 
     def _attach_bp(self, out, chunk, pairs, n, m, max_len, bp_meta, put):
@@ -694,14 +802,15 @@ class TpuAligner(PallasDispatchMixin):
             w=w, NW=NW)
         return bp_first, bp_last, score, fi, fj
 
-    def _dispatch(self, args, max_len, band, steps, use_pallas):
+    def _dispatch(self, args, max_len, band, steps, use_pallas,
+                  use_swar=False):
         if self.mesh is not None:
             from ..parallel import sharded_align
             return sharded_align(self.mesh, *args, max_len=max_len,
                                  band=band, steps=steps,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, use_swar=use_swar)
         return align_chain(*args, max_len=max_len, band=band, steps=steps,
-                           use_pallas=use_pallas)
+                           use_pallas=use_pallas, use_swar=use_swar)
 
     def _finish_chunk(self, launched, band, cigars, reject, bp_meta=None):
         chunk, pairs, n, m, out, (max_len, shape_key) = launched

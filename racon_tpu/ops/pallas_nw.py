@@ -304,17 +304,213 @@ def _fwd_kernel(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
             stage_dma(s, (g + 1) * PER - 1).wait()
 
 
+def _fwd_kernel_swar(qrp_ref, tp_ref, n_ref, m_ref, dirs_ref, score_ref,
+                     stage, dsems, *, max_len: int, band: int, P: int,
+                     width: int, steps: int, PER: int, out_quant: int):
+    """SWAR-packed forward kernel: two int16 wavefront scores per int32
+    lane, biased-unsigned halfword arithmetic (``ops.swar``), so the
+    carry state, the rolls and every min/add run on half the vector
+    lanes. **Planar** halfword layout — packed word ``k`` holds lanes
+    ``u = k`` (low) and ``u = k + U/4`` (high) — so the DP's +-1 lane
+    shifts stay single-word rolls (one seam word fixed per shift) and
+    the 2-bit direction planes fall out of the halfword halves with no
+    cross-lane shuffle. Bit-identical direction matrix and scores vs
+    ``_fwd_kernel`` (see the ``ops.swar`` module docstring for why the
+    saturation classes line up); probed by ``pallas_swar_ok()``."""
+    from .swar import (BIG16, LO16, ONES16, TWOS16, swar16_eq, swar16_ge,
+                       swar16_ne_small, swar16_sel)
+    W = band
+    c = W // 2
+    L = max_len
+    U = W // 2
+    U2 = U // 2           # packed words per wavefront
+    RB = U // 4
+    S = steps
+    FL = RB
+    while FL % 128:
+        FL += RB
+    F = FL // RB
+    FPL = FL * PER
+    blk = pl.program_id(0)
+    nn = n_ref[:, :]
+    mm = m_ref[:, :]
+    lane = lax.broadcasted_iota(jnp.int32, (P, U2), 1)
+    # packed u iota: low field u = k, high field u = k + U2 (planar)
+    usp = lane | ((lane + U2) << 16)
+    usp1 = usp + ONES16   # u + 1 (inclusive upper bounds compare via +1)
+    BIGS = jnp.int32(BIG16 * 0x00010001)
+
+    def stage_dma(slot, fidx):
+        base = (fidx + 1) * FL - FPL
+        return pltpu.make_async_copy(
+            stage.at[slot],
+            dirs_ref.at[pl.ds(blk * P, P),
+                        pl.ds(pl.multiple_of(base, 128), FPL)],
+            dsems.at[slot])
+
+    assert c % 2 == 0, "band/2 must be even for the two-step parity fold"
+    p0 = c & 1
+    u0 = (c - p0) // 2
+    zrow = jnp.minimum(nn, 0)  # row-varying layout forcer (_fwd_kernel)
+    lo0 = jnp.where(lane == u0, 0, BIG16)
+    hi0 = jnp.where(lane == u0 - U2, 0, BIG16)
+    v0 = (lo0 | (hi0 << 16)) + zrow
+    vm1 = jnp.full((P, U2), BIGS, jnp.int32) + zrow
+    svec0 = jnp.full((P, U2), BIGS, jnp.int32) + zrow
+    dbuf0 = jnp.zeros((P, FL), jnp.int32) + zrow
+
+    def substep(a, p, v1, v2, svec, dbuf, qpl, tpl, trim):
+        I0 = (a + c - p) // 2
+        J0 = (a - c + p) // 2
+
+        # +-1 lane shifts: both planar halves shift together, so one
+        # word roll + one seam-word fixup replaces the halfword shuffle
+        # an interleaved layout would need on every lane
+        if p == 0:
+            r = pltpu.roll(v1, shift=1, axis=1)   # word k <- v1[k-1]
+            # seam word 0: low = BIG (u = -1), high = v1[U2-1].low
+            d_src = jnp.where(lane == 0, (r << 16) | BIG16, r)
+            i_src = v1
+        else:
+            d_src = v1
+            r = pltpu.roll(v1, shift=U2 - 1, axis=1)  # word k <- v1[k+1]
+            # seam word U2-1: low = v1[0].high (u = U2), high = BIG
+            i_src = jnp.where(lane == U2 - 1,
+                              ((r >> 16) & LO16) | (BIG16 << 16), r)
+
+        # XOR + mask SWAR equality on the packed 4-bit codes
+        sub = swar16_ne_small(qpl ^ tpl, 4)
+        cd = v2 + sub          # diagonal (i-1, j-1)
+        ci = i_src + ONES16    # consume query (i-1, j)
+        cdel = d_src + ONES16  # consume target (i, j-1)
+        mB = swar16_ge(cdel, ci)    # I beats D on ties (walker order)
+        m2 = swar16_sel(ci, cdel, mB)
+        mA = swar16_ge(m2, cd)      # diagonal wins ties
+        best = swar16_sel(cd, m2, mA)
+        d = swar16_sel(ONES16, TWOS16, mB) & ~mA  # 0 where diag won
+
+        # interior as a contiguous lane range [lo, hi] (the four i/j
+        # bounds are monotone in u), checked per halfword against the
+        # packed u iota; saturation folds into the same select
+        if trim:
+            lo = jnp.maximum(I0 - nn, 0)
+            hi1 = jnp.clip(mm - J0 + 1, 0, U)
+        else:
+            lo = jnp.maximum(jnp.maximum(I0 - nn, 1 - J0), 0)
+            hi1 = jnp.clip(jnp.minimum(mm - J0, I0 - 1) + 1, 0, U)
+        rng_m = (swar16_ge(usp, lo * ONES16)
+                 & swar16_ge(hi1 * ONES16, usp1))
+        v = swar16_sel(best, BIGS, swar16_ge(BIGS, best) & rng_m)
+        if not trim:
+            # DP boundary rows/cols (only reachable at a <= c): at
+            # i == 0 the value is j = a, at j == 0 it is i = a — one
+            # shared select with per-pair validity predicates
+            pj = jnp.where(a <= mm, -1, 0)
+            pi = jnp.where(a <= nn, -1, 0)
+            bm = ((swar16_eq(usp, I0 * ONES16) & pj)
+                  | (swar16_eq(usp, (-J0) * ONES16) & pi))
+            v = swar16_sel(a * ONES16, v, bm)
+
+        # final score lives at a == n + m, u_fin = (m - n + c - p) / 2
+        u_fin = jnp.clip((mm - nn + c - p) // 2, 0, U - 1)
+        fm = (swar16_eq(usp, u_fin * ONES16)
+              & jnp.where(a == nn + mm, -1, 0))
+        svec = swar16_sel(v, svec, fm)
+
+        # planar 2-bit pack straight off the halfword halves: byte k =
+        # lanes (k, k+RB, k+2RB, k+3RB) = (t1.lo, t2.lo, t1.hi, t2.hi)
+        t1 = d[:, :RB]
+        t2 = d[:, RB:]
+        packed = ((t1 & 3) | ((t2 & 3) << 2) | (((t1 >> 16) & 3) << 4)
+                  | (((t2 >> 16) & 3) << 6))
+        if FL == RB:
+            dbuf = packed
+        else:
+            dbuf = pltpu.roll(dbuf, shift=FL - RB, axis=1)
+            dbuf = jnp.concatenate([dbuf[:, :FL - RB], packed], axis=1)
+
+        @pl.when(a % F == 0)
+        def _():
+            fidx = a // F - 1            # 0-based flush index
+            slot = (fidx // PER) % 2
+
+            @pl.when((fidx % PER == 0) & (fidx >= 2 * PER))
+            def _():
+                stage_dma(slot, fidx - PER).wait()
+
+            stage[slot, :, pl.ds(pl.multiple_of((fidx % PER) * FL, 128),
+                                 FL)] = dbuf.astype(jnp.uint8)
+
+            @pl.when(fidx % PER == PER - 1)
+            def _():
+                stage_dma(slot, fidx).start()
+
+        return v, v1, svec, dbuf
+
+    def planar(win):
+        return win[:, :U2] | (win[:, U2:] << 16)
+
+    qpl0 = planar(_load_window(qrp_ref, c + L - c // 2, width, U))
+
+    def two_steps(k, carry, trim):
+        v1, v2, svec, dbuf, qpl = carry
+        a1 = 2 * k + 1                   # p = 1
+        tpl = planar(_load_window(tp_ref, c + (a1 - c + 1) // 2 - 1,
+                                  width, U))
+        v1, v2, svec, dbuf = substep(a1, 1, v1, v2, svec, dbuf,
+                                     qpl, tpl, trim)
+        a2 = 2 * k + 2                   # p = 0
+        qpl = planar(_load_window(qrp_ref, c + L - (a2 + c) // 2,
+                                  width, U))
+        v1, v2, svec, dbuf = substep(a2, 0, v1, v2, svec, dbuf,
+                                     qpl, tpl, trim)
+        return v1, v2, svec, dbuf, qpl
+
+    QB = max(out_quant, F * PER)
+    assert QB % 128 == 0 and QB % (F * PER) == 0, (F, PER)
+    if DYNAMIC_BOUND:
+        maxnm = jnp.max(nn + mm)
+        bound = jnp.minimum(jnp.int32(S), ((maxnm + QB - 1) // QB) * QB)
+    else:
+        bound = jnp.int32(S)
+
+    ksplit = jnp.minimum(jnp.int32(c // 2), bound // 2)
+    carry = lax.fori_loop(
+        0, ksplit, functools.partial(two_steps, trim=False),
+        (v0, vm1, svec0, dbuf0, qpl0))
+    _, _, svec, _, _ = lax.fori_loop(
+        ksplit, bound // 2, functools.partial(two_steps, trim=True), carry)
+    s16 = jnp.minimum(
+        jnp.min(svec & LO16, axis=1, keepdims=True),
+        jnp.min((svec >> 16) & LO16, axis=1, keepdims=True))
+    s32 = jnp.where(s16 == BIG16, jnp.int32(_BIG), s16)
+    score_ref[:, :] = jnp.where(nn + mm == 0, 0, s32)
+
+    NFb = bound // F
+    last = NFb // PER - 1
+    for s in (0, 1):
+        g = last - ((last - s) % 2)
+
+        @pl.when((NFb > 0) & (g >= 0))
+        def _(s=s, g=g):
+            stage_dma(s, (g + 1) * PER - 1).wait()
+
+
 @functools.partial(jax.jit, static_argnames=("max_len", "band", "steps",
-                                             "out_quant"))
+                                             "out_quant", "use_swar"))
 def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
-                  steps: int = 0, out_quant: int = 128):
+                  steps: int = 0, out_quant: int = 128,
+                  use_swar: bool = False):
     """Drop-in Pallas replacement for ``_nw_wavefront_kernel``: same
     inputs, same packed direction matrix [B, steps, RB] and scores [B]
     (``steps`` defaults to the full ``2*max_len`` sweep). ``out_quant``
     is the downstream walk's read granularity in rows: 512 when the
     packed-output aligner walk consumes the matrix, 128 (default) for
     the consensus vote walk — the dynamic sweep bound rounds up to it so
-    the consumer never reads unwritten rows."""
+    the consumer never reads unwritten rows. ``use_swar`` runs the
+    int16x2-packed variant (``_fwd_kernel_swar``, bit-identical
+    outputs); callers gate it on ``pallas_swar_ok()`` plus the
+    ``swar.swar_fits`` overflow guard."""
     B0, width = qrp.shape
     if B0 < 8:
         qrp, tp, n, m = _pad_rows([qrp, tp, n, m], B0, [0, 0, 1, 1])
@@ -338,7 +534,8 @@ def pallas_nw_fwd(qrp, tp, n, m, *, max_len: int, band: int,
         PER *= 2
     qrp = jnp.pad(qrp, ((0, 0), (0, _LOAD_PAD)))
     tp = jnp.pad(tp, ((0, 0), (0, _LOAD_PAD)))
-    kernel = functools.partial(_fwd_kernel, max_len=max_len, band=band,
+    fwd = _fwd_kernel_swar if use_swar else _fwd_kernel
+    kernel = functools.partial(fwd, max_len=max_len, band=band,
                                P=P, width=width, steps=S, PER=PER,
                                out_quant=out_quant)
     dirs, score = pl.pallas_call(
@@ -667,23 +864,24 @@ def pallas_ok() -> bool:
                 from .poa import (CH, DEL, _accumulate_votes,
                                   _vote_from_ops)
                 L, K, nW = max_len, 4, 4
-                qcodes = jnp.asarray(
-                    rng.integers(0, 5, (B, max_len)).astype(np.uint8))
-                qweights = jnp.asarray(
-                    rng.integers(0, 60, (B, max_len)).astype(np.uint8))
+                qcodes = rng.integers(0, 5, (B, max_len)).astype(np.uint8)
+                qweights = rng.integers(0, 60,
+                                        (B, max_len)).astype(np.uint8)
+                qpw = jnp.asarray(
+                    (qweights.astype(np.uint16) << 3) | qcodes)
                 bg = jnp.asarray(rng.integers(0, 8, B).astype(np.int32))
                 win_of = jnp.asarray(
                     (np.arange(B) % (nW - 1)).astype(np.int32))
                 idxx, wx8, okx = _vote_from_ops(
                     jnp.asarray(ox), jnp.asarray(fix), jnp.asarray(fjx),
-                    jnp.asarray(sx), args[2], args[3], qcodes, qweights,
+                    jnp.asarray(sx), args[2], args[3], qpw,
                     bg, max_len=max_len, band=band, L=L, K=K)
                 wx, ux, _ovx = _accumulate_votes(
                     idxx, wx8, okx, win_of, args[3], bg, args[2],
                     jnp.asarray(sx), n_windows=nW, L=L, K=K, band=band)
                 idx, w8, fiv, fjv = pallas_walk_vote(
-                    jnp.asarray(dp), args[2], args[3], bg, qcodes,
-                    qweights, band=band, L=L, K=K, CH=CH, DEL=DEL)
+                    jnp.asarray(dp), args[2], args[3], bg, qpw,
+                    band=band, L=L, K=K, CH=CH, DEL=DEL)
                 okv = ((fiv == 0) & (fjv == 0)
                        & (jnp.asarray(sp) < (band // 2)))
                 wp, up, _ovp = _accumulate_votes(
@@ -698,7 +896,58 @@ def pallas_ok() -> bool:
     return _PALLAS_OK
 
 
-def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
+_PALLAS_SWAR_OK = None
+
+
+def pallas_swar_ok() -> bool:
+    """Probe once whether the SWAR-packed Mosaic forward kernel
+    (``_fwd_kernel_swar``) reproduces the XLA reference bit-for-bit on a
+    random small batch. Separate memo from ``pallas_ok()`` so a packed-
+    kernel regression downgrades only the packed path — the int32 Pallas
+    kernels keep running."""
+    global _PALLAS_SWAR_OK
+    if _PALLAS_SWAR_OK is None:
+        if not pallas_ok():
+            _PALLAS_SWAR_OK = False
+            return False
+        try:
+            import numpy as np
+            from .nw import _nw_wavefront_kernel
+
+            max_len, band = 256, 128
+            B, c = 8, band // 2
+            width = c + max_len + band
+            rng = np.random.default_rng(17)
+            bases = np.frombuffer(b"ACGT", np.uint8)
+            qrp = np.zeros((B, width), np.uint8)
+            tp = np.zeros((B, width), np.uint8)
+            n = np.zeros(B, np.int32)
+            m = np.zeros(B, np.int32)
+            for k in range(B):
+                ln = int(rng.integers(60, 200))
+                t = bases[rng.integers(0, 4, ln)]
+                q = np.delete(t.copy(), rng.integers(0, ln, 4))
+                flips = rng.random(len(q)) < 0.2
+                q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+                qrp[k, c + max_len - len(q): c + max_len] = q[::-1]
+                tp[k, c: c + ln] = t
+                n[k], m[k] = len(q), ln
+            args = (jnp.asarray(qrp), jnp.asarray(tp),
+                    jnp.asarray(n), jnp.asarray(m))
+            dp, sp = pallas_nw_fwd(*args, max_len=max_len, band=band,
+                                   out_quant=512, use_swar=True)
+            dx, sx = _nw_wavefront_kernel(*args, max_len=max_len,
+                                          band=band)
+            dp, sp, dx, sx = map(np.asarray, (dp, sp, dx, sx))
+            mx = int((n + m).max())
+            _PALLAS_SWAR_OK = (np.array_equal(dp[:, :mx], dx[:, :mx])
+                               and np.array_equal(sp, sx))
+        except Exception:
+            _PALLAS_SWAR_OK = False
+    return _PALLAS_SWAR_OK
+
+
+def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qpw_ref,
                       idx_ref, w_ref, fi_ref, fj_ref, buf, sems, *,
                       band: int, P: int, C: int, steps: int, Lq: int,
                       L: int, K: int, CH: int, DEL: int):
@@ -715,9 +964,10 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
 
     The layer base/weight lookup is ONE per-pair masked max-reduce over
     the (P, Lq) query rows held in VMEM (only one lane matches ``i - 1``,
-    so max == select): code and weight are pre-packed per lane as
-    ``weight << 3 | code`` (codes are 0..4, weights integral 0..93), so
-    the dominant per-step O(Lq) scan runs once, not twice.
+    so max == select): codes and weights **travel packed** from the host
+    as ``weight << 3 | code`` uint16 lanes (codes are 0..4, weights
+    integral 0..93 — ``poa._pack_shard``), so one VMEM block and one
+    per-step O(Lq) scan serve both lookups.
     """
     W = band
     c = W // 2
@@ -733,8 +983,7 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
     bg = bg_ref[:, :]
     # packed i32 view for the per-step select (Mosaic only reduces
     # i32/f32): weight<<3 | code per lane, one reduce recovers both
-    qpw = ((qw_ref[:, :].astype(jnp.int32) << 3)
-           | qc_ref[:, :].astype(jnp.int32))   # (P, Lq)
+    qpw = qpw_ref[:, :].astype(jnp.int32)      # (P, Lq)
     lane_ww = lax.broadcasted_iota(jnp.int32, (P, WW), 1)
     lane_q = lax.broadcasted_iota(jnp.int32, (P, Lq), 1)
     chunk_dma = _chunk_dma_factory(dirs_ref, buf, sems, blk,
@@ -815,17 +1064,18 @@ def _walk_vote_kernel(dirs_ref, n_ref, m_ref, bg_ref, qc_ref, qw_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("band", "L", "K", "CH", "DEL"))
-def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
+def pallas_walk_vote(dirs, n, m, bg, qpw, *, band: int,
                      L: int, K: int, CH: int, DEL: int):
-    """Fused walk + vote emission. Returns (idx [B,S] i32 — vote address
-    or the sink VOT, w [B,S] u8, fi, fj). Replaces ``pallas_walk_ops`` +
-    the XLA prefix-sum vote prep on the consensus path."""
+    """Fused walk + vote emission over the packed ``weight << 3 | code``
+    uint16 query block. Returns (idx [B,S] i32 — vote address or the
+    sink VOT, w [B,S] u8, fi, fj). Replaces ``pallas_walk_ops`` + the
+    XLA prefix-sum vote prep on the consensus path."""
     B0 = dirs.shape[0]
     if B0 < 8:
-        dirs, n, m, bg, qcodes, qweights_u8 = _pad_rows(
-            [dirs, n, m, bg, qcodes, qweights_u8], B0, [0, 1, 1, 0, 0, 0])
+        dirs, n, m, bg, qpw = _pad_rows(
+            [dirs, n, m, bg, qpw], B0, [0, 1, 1, 0, 0])
     B, S, RB = dirs.shape
-    Lq = qcodes.shape[1]
+    Lq = qpw.shape[1]
     C = min(128, S)
     P = _cap_block(B, 2 * (C * RB + _rup(128 + RB, 128)), _WALK_BUF_BYTES)
     if S % C:
@@ -842,7 +1092,6 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((P, Lq), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((P, Lq), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -863,5 +1112,5 @@ def pallas_walk_vote(dirs, n, m, bg, qcodes, qweights_u8, *, band: int,
         ],
     )(dirs.reshape(B, S * RB), n.reshape(B, 1).astype(jnp.int32),
       m.reshape(B, 1).astype(jnp.int32),
-      bg.reshape(B, 1).astype(jnp.int32), qcodes, qweights_u8)
+      bg.reshape(B, 1).astype(jnp.int32), qpw.astype(jnp.uint16))
     return idx[:B0], w[:B0], fi.reshape(B)[:B0], fj.reshape(B)[:B0]

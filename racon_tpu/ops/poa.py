@@ -139,21 +139,24 @@ _BYTE_LUT = np.frombuffer(b"ACGTN-", dtype=np.uint8)
 
 @functools.partial(jax.jit,
                    static_argnames=("max_len", "band", "L", "K"))
-def _vote_from_ops(ops, fi, fj, score, n, m, qcodes, qweights, begin,
+def _vote_from_ops(ops, fi, fj, score, n, m, qpw, begin,
                    *, max_len: int, band: int, L: int, K: int):
     """Turn walked op codes into the (idx, w, ok) vote stream — vectorized.
 
     ops: uint8 [B, S] backward-walk op codes from ``_walk_ops_kernel``
-    (0=M, 1=I, 2=D, >=3 done/stalled); qcodes/qweights: [B, max_len] layer
-    base codes and weights; begin: [B] backbone-span start column.
+    (0=M, 1=I, 2=D, >=3 done/stalled); qpw: [B, max_len] uint16 layer
+    base codes and phred weights packed ``weight << 3 | code`` (the same
+    lane format the fused Pallas emitter consumes — codes 3 bits,
+    weights <= 93 in 7); begin: [B] backbone-span start column.
 
     The walk position *before* step t is recovered with prefix sums of the
     consumed-query/-target indicators (no sequential re-walk), the
     insertion-run length with a prefix max over the last non-insertion
-    step, and the layer base/weight lookups are one batched gather each —
-    everything is [B, S] elementwise work. The XLA twin of the fused
-    Pallas emitter (``pallas_walk_vote``): both produce the identical
-    stream consumed by :func:`_accumulate_votes`.
+    step, and the layer base+weight lookup is ONE batched gather on the
+    packed lanes (it used to be two) — everything is [B, S] elementwise
+    work. The XLA twin of the fused Pallas emitter
+    (``pallas_walk_vote``): both produce the identical stream consumed
+    by :func:`_accumulate_votes`.
 
     Vote layout: column votes at col*CH+ch, insertion slot s of junction
     col at (L + col*K + s)*CH + ch, sink VOT for non-votes. Insertion
@@ -182,10 +185,11 @@ def _vote_from_ops(ops, fi, fj, score, n, m, qcodes, qweights, begin,
     slot = jnp.minimum(ins_run, K - 1)
 
     qpos = jnp.clip(i_t - 1, 0, Lq - 1)
-    base = jnp.take_along_axis(qcodes, qpos, axis=1).astype(jnp.int32)
-    # weights travel as uint8 (integral 0..93 phred, or 1 for no-quality
+    pw = jnp.take_along_axis(qpw, qpos, axis=1).astype(jnp.int32)
+    base = pw & 7
+    # weights travel packed (integral 0..93 phred, or 1 for no-quality
     # layers) — identical values to the Pallas emitter's
-    wgt = jnp.take_along_axis(qweights, qpos, axis=1).astype(jnp.int32)
+    wgt = pw >> 3
     col = begin[:, None] + j_t - 1
     # vote target: M -> (col, base); D -> (col, DEL); I -> ins slot
     idx = jnp.where(
@@ -279,9 +283,13 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
       HIGHEST precision) then reduces pairs into windows on the MXU;
     - **insertion votes** (~2%): compacted to the first ``band//2`` lanes
       (an ok pair has score < band//2, so it cannot carry more insertion
-      steps than that) and scatter-added with the weight and the count
-      packed into one u32 cell — counts are bounded by the layer depth
-      (drop-collapse rule), so the fields cannot carry into each other.
+      steps than that) and scatter-added into a **u32 pair** per address
+      (weight table + count table). The old single-u32 packing (weight
+      bits 0-22, count bits 23-31) silently carried the count into the
+      weight field past 511 votes per address — it was what capped the
+      voting depth at 511; the widened pair is exact to depth 2^32 and
+      the depth ceiling now comes from the f32-exactness of the column
+      matmul (see ``TpuPoaConsensus.__init__``).
 
     **Score-weighted voting** (the -m/-x/-g contract, the analog of
     cudapoa consuming the CLI scores directly,
@@ -381,9 +389,16 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
     # stream (lax.cond compiles both, the fast path runs when clean);
     # the returned tally counts the overflowing items for telemetry.
     def pack_scatter(flat, w):
-        val = w.astype(jnp.uint32) + ((w > 0).astype(jnp.uint32) << 23)
-        return jnp.zeros(nW * INS + 1, jnp.uint32
-                         ).at[flat.reshape(-1)].add(val.reshape(-1))
+        # widened accumulator: weight and count land in separate u32
+        # tables (a u64 pair per address) — the old 23-bit weight /
+        # 9-bit count split of one u32 saturated silently at depth 511,
+        # carrying counts into the weight bits
+        fl = flat.reshape(-1)
+        wt = jnp.zeros(nW * INS + 1, jnp.uint32).at[fl].add(
+            w.reshape(-1).astype(jnp.uint32))
+        ct = jnp.zeros(nW * INS + 1, jnp.uint32).at[fl].add(
+            (w.reshape(-1) > 0).astype(jnp.uint32))
+        return wt, ct
 
     G, CAP_DIV = 32, 4
     if B % G == 0 and (G * IC) % CAP_DIV == 0:
@@ -395,18 +410,17 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
             ialive.reshape(rows, G * IC), (f2, w2), G * IC)
         ins_overflow = jnp.sum((alive2[:, cap:] & (w2[:, cap:] > 0)
                                 ).astype(jnp.int32))
-        itab = lax.cond(
+        itab_w, itab_c = lax.cond(
             ins_overflow == 0,
             lambda: pack_scatter(
                 jnp.where(alive2[:, :cap], f2[:, :cap], nW * INS),
                 w2[:, :cap]),
             lambda: pack_scatter(iflat, iw))
     else:  # tiny batches: skip the fold
-        itab = pack_scatter(iflat, iw)
+        itab_w, itab_c = pack_scatter(iflat, iw)
         ins_overflow = jnp.int32(0)
-    itab = itab[:nW * INS]
-    ins_w = (itab & ((1 << 23) - 1)).astype(jnp.float32).reshape(nW, INS)
-    ins_c = (itab >> 23).astype(jnp.int32).reshape(nW, INS)
+    ins_w = itab_w[:nW * INS].astype(jnp.float32).reshape(nW, INS)
+    ins_c = itab_c[:nW * INS].astype(jnp.int32).reshape(nW, INS)
 
     weighted = jnp.concatenate([w_cols, ins_w], axis=1)
     unweighted = jnp.concatenate([c_cols.astype(jnp.int32), ins_c], axis=1)
@@ -469,13 +483,14 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
 
 @functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
                                              "Lb", "K", "steps",
-                                             "use_pallas", "Lq2",
-                                             "scores"))
-def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
+                                             "use_pallas", "use_swar",
+                                             "Lq2", "scores"))
+def refine_round(n, qpw, win_of, real, bg, ed,
                  bcodes, bweights, blen, covs, ever, frozen, conv,
                  dropped, ins_theta, del_beta, *, n_windows: int,
                  max_len: int, band: int, Lb: int, K: int, steps: int = 0,
-                 use_pallas: bool = False, Lq2: int = 0,
+                 use_pallas: bool = False, use_swar: bool = False,
+                 Lq2: int = 0,
                  scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
     """One fully-device-resident refinement round.
 
@@ -499,6 +514,12 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     wavefront steps). The single source of truth for the round wiring,
     wrapped by :func:`refine_loop` (all rounds in one dispatch) and the
     ``shard_map`` path (``racon_tpu.parallel.sharded_refine_loop``).
+
+    Layer codes and phred weights travel packed (``qpw`` uint16 lanes,
+    ``weight << 3 | code`` — one transfer array instead of two, one
+    gather in the vote prep, one VMEM block in the fused Pallas
+    emitter); ``use_swar`` runs the forward DP on int16x2-packed score
+    lanes (bit-identical outputs, see ``ops.swar``).
     """
     Lq = max_len
     # the vote emitters only read query lanes < the longest real layer —
@@ -507,7 +528,8 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     Lq2 = Lq2 or Lq
     c = band // 2
     width = c + Lq + band
-    B = qcodes.shape[0]
+    B = qpw.shape[0]
+    qcodes = (qpw & 7).astype(jnp.uint8)  # unpacked codes for the rows
     # convergence gating: pairs of a window whose backbone reproduced
     # itself last round are zeroed out (n = m = 0) — their walk ends
     # immediately, they emit no votes, and the Pallas kernels' per-block
@@ -542,20 +564,20 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
     if use_pallas:
         from .pallas_nw import pallas_nw_fwd, pallas_walk_vote
         packed, score = pallas_nw_fwd(qrp, tp, n, m,
-                                      max_len=Lq, band=band, steps=steps)
+                                      max_len=Lq, band=band, steps=steps,
+                                      use_swar=use_swar)
         idx, w8, fi, fj = pallas_walk_vote(packed, n, m, bg,
-                                           qcodes[:, :Lq2],
-                                           qweights[:, :Lq2], band=band,
+                                           qpw[:, :Lq2], band=band,
                                            L=Lb, K=K, CH=CH, DEL=DEL)
         okp = (fi == 0) & (fj == 0) & (score < (band // 2))
         wv = w8.astype(jnp.int32)
     else:
         packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                              max_len=Lq, band=band,
-                                             steps=steps)
+                                             steps=steps, swar=use_swar)
         ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band)
         idx, wv, okp = _vote_from_ops(
-            ops, fi, fj, score, n, m, qcodes[:, :Lq2], qweights[:, :Lq2],
+            ops, fi, fj, score, n, m, qpw[:, :Lq2],
             bg, max_len=Lq2, band=band, L=Lb, K=K)
     weighted, unweighted, ins_ovf = _accumulate_votes(
         idx, wv, okp, win_of, m, bg, n, score, n_windows=n_windows,
@@ -650,13 +672,14 @@ def refine_round(n, qcodes, qweights, win_of, real, bg, ed,
 @functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
                                              "max_len", "band", "Lb", "K",
                                              "steps", "use_pallas",
-                                             "Lq2", "scores"))
-def refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
+                                             "use_swar", "Lq2", "scores"))
+def refine_loop(n, qpw, win_of, real, bg, ed,
                 bcodes, bweights, blen, covs, ever, frozen, conv,
                 dropped, ins_theta, del_beta, *, rounds: int,
                 n_windows: int,
                 max_len: int, band: int, Lb: int, K: int, steps: int = 0,
-                use_pallas: bool = False, Lq2: int = 0,
+                use_pallas: bool = False, use_swar: bool = False,
+                Lq2: int = 0,
                 scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
     """All refinement rounds of a group in ONE device dispatch.
 
@@ -678,10 +701,10 @@ def refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
 
     def body(carry):
         out = refine_round(
-            n, qcodes, qweights, win_of, real, *carry[1:], ins_theta,
+            n, qpw, win_of, real, *carry[1:], ins_theta,
             del_beta, n_windows=n_windows, max_len=max_len, band=band,
-            Lb=Lb, K=K, steps=steps, use_pallas=use_pallas, Lq2=Lq2,
-            scores=scores)
+            Lb=Lb, K=K, steps=steps, use_pallas=use_pallas,
+            use_swar=use_swar, Lq2=Lq2, scores=scores)
         return (carry[0] + 1,) + tuple(out)
 
     state = (bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
@@ -707,7 +730,7 @@ def _fetch_pack(bcodes, blen, covs, ever, frozen, conv, dropped, bg, ed):
 @functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
                                              "max_len", "band", "Lb", "K",
                                              "steps", "use_pallas",
-                                             "Lq2", "scores"))
+                                             "use_swar", "Lq2", "scores"))
 def _refine_loop_packed(*args, **kw):
     """refine_loop + the coalesced-fetch packing in ONE jitted program:
     the tunnel charges ~0.5-1.3 s per dispatched execution, so running
@@ -755,15 +778,18 @@ class TpuPoaConsensus(PallasDispatchMixin):
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
                  max_depth: int = 200, band: int = BAND, rounds: int = 6,
                  mesh=None, ins_theta: float = 0.25, del_beta: float = 0.65,
-                 num_batches: int = 1):
+                 num_batches: int = 1, use_swar: bool = True):
         self.fallback = fallback
         # device ceiling (companion to the K_INS/CH caps in the module
-        # docstring): _accumulate_votes packs each insertion-vote cell as
-        # weight (bits 0-22) + count (bits 23-31) in one u32, so the
-        # per-address count — bounded by the voting depth via the
-        # drop-collapse rule — must fit 9 bits. Deeper requests clamp
-        # here rather than silently carrying between the packed fields.
-        self.max_depth = min(max_depth, 511)
+        # docstring): the insertion accumulator is now a u32 pair per
+        # address (_accumulate_votes), so the old 9-bit-count cap (511)
+        # is gone; the binding limit is the f32 exactness of the column
+        # one-hot matmul — per-column weighted sums must stay < 2^24,
+        # and a vote carries at most 93 * 88 (phred x alpha) plus the
+        # backbone's 64 * 60, so depth 2047 is the largest exact depth:
+        # 2047 * 8184 + 3840 < 2^24. Deeper requests clamp here rather
+        # than silently losing integer exactness.
+        self.max_depth = min(max_depth, 2047)
         self.band = band
         self.rounds = rounds
         self.mesh = mesh
@@ -794,6 +820,11 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # is dispatched before the first result is fetched (JAX async
         # dispatch), so host packing overlaps device compute.
         self.num_batches = max(1, num_batches)
+        # SWAR-packed forward DP (int16x2 score lanes); bit-identical
+        # outputs, guarded per geometry by swar.swar_fits and globally
+        # by the swar_ok probe — the knob exists for A/B measurement
+        self.use_swar = use_swar
+        self._warmup = None
         # wavefront_steps: executed (post-gating) DP anti-diagonal steps,
         # the honest numerator for utilization estimates (bench.py)
         self.stats = {"device_windows": 0, "fallback_windows": 0,
@@ -821,23 +852,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
         if live:
             max_bb = max(len(w.backbone) for _, w in live)
-            # the alignment band scales with the window length (cudapoa's
-            # banded width is proportional to its matrix size too): a
-            # fixed 512-lane band caps acceptable per-layer edits at 256,
-            # which w>=1000 windows at ONT divergence routinely exceed —
-            # those layers' alignments were dropped wholesale, the r4
-            # w=1000 quality cliff (device 2591 vs CPU 1289 with ~1.2k
-            # dropped alignments). Identity for <=512 bp windows, so
-            # every recorded w=500 golden is untouched.
-            band = min(self.band * -(-max_bb // 512), 4096)
-            # device ceiling: the packed insertion payload holds
-            # addr << 13 in an int32, so Lb*K_INS*CH must fit 18 bits
-            # (Lb <= 8192); longer backbones take the CPU fallback like
-            # any other reject
-            max_dev_L = (1 << 18) // (K_INS * CH) - GROW
-            L = max(256, min(-(-max_bb // 256) * 256, max_dev_L))
-            Lq = L + band
-            Lb = min(L + GROW, Lq)  # backbone buffer (span fit: Lb <= Lq)
+            band, L, Lq, Lb = self._bucket_geometry(max_bb)
             self.stats["band"] = band
             # windows whose layers exceed the pair buffer (or backbones the
             # backbone buffer) go to the CPU fallback via results[i] None
@@ -847,18 +862,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
         if live:
             # anti-diagonal sweep bound: longest real pair plus span-growth
-            # slack, rounded to 256 (dead wavefronts past the last finish
-            # are pure waste; a span that outgrows the slack drops that
-            # pair's votes for the round, like a band escape)
+            # slack (dead wavefronts past the last finish are pure waste;
+            # a span that outgrows the slack drops that pair's votes for
+            # the round, like a band escape)
             max_nm = max(
                 len(s) + min((e - b + 1) + 64, Lb)
                 for _, w in live for s, _, b, e in w.layers)
-            # multiple of 128: the Pallas kernels chunk/flush at 128-lane
-            # granularity and statically require it
-            steps = -(-min(-(-max_nm // 128) * 128, 2 * Lq) // 128) * 128
-            # vote-kernel query-block width: longest real layer, padded
             max_n = max(len(s) for _, w in live for s, _, _, _ in w.layers)
-            Lq2 = min(Lq, -(-max_n // 128) * 128)
+            steps, Lq2 = self._sweep_geometry(Lq, max_nm, max_n)
             from ..parallel import partition_balanced
             total_pairs = sum(len(w.layers) for _, w in live)
             n_groups = max(self.num_batches,
@@ -893,8 +904,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
             survivors = [] if two_stage else None
             ra = min(self.rounds, STAGE_A_ROUNDS) if two_stage \
                 else self.rounds
-            # per-launch resident bytes: packed pair inputs PLUS the
-            # per-window state and coalesced-fetch arrays each
+            # per-launch resident bytes: packed pair inputs (the qpw
+            # uint16 lanes are 2*Lq bytes/pair — codes and weights
+            # travel in ONE array; +24 covers n/bg/ed/win_of/real) PLUS
+            # the per-window state and coalesced-fetch arrays each
             # un-fetched launch pins (bcodes u8 + covs/mat i32 +
             # bweights f32 ~ 13 bytes per backbone column, padded to
             # the worst group's power-of-two window count)
@@ -945,6 +958,117 @@ class TpuPoaConsensus(PallasDispatchMixin):
             progress(total_units, total_units)
         return [bool(r) for r in results]
 
+    # ----------------------------------------------------------- geometry
+
+    def _bucket_geometry(self, max_bb: int):
+        """Static kernel geometry from the longest backbone — THE single
+        source of truth shared by :meth:`run` and :meth:`warmup_async`
+        (drift between them would silently waste the warm-up compile).
+
+        The alignment band scales with the window length (cudapoa's
+        banded width is proportional to its matrix size too): a fixed
+        512-lane band caps acceptable per-layer edits at 256, which
+        w>=1000 windows at ONT divergence routinely exceed — those
+        layers' alignments were dropped wholesale, the r4 w=1000 quality
+        cliff (device 2591 vs CPU 1289 with ~1.2k dropped alignments).
+        Identity for <=512 bp windows, so every recorded w=500 golden is
+        untouched. Device ceiling: the packed insertion payload holds
+        addr << 13 in an int32, so Lb*K_INS*CH must fit 18 bits
+        (Lb <= 8192); longer backbones take the CPU fallback like any
+        other reject."""
+        band = min(self.band * -(-max_bb // 512), 4096)
+        max_dev_L = (1 << 18) // (K_INS * CH) - GROW
+        L = max(256, min(-(-max_bb // 256) * 256, max_dev_L))
+        Lq = L + band
+        Lb = min(L + GROW, Lq)  # backbone buffer (span fit: Lb <= Lq)
+        return band, L, Lq, Lb
+
+    @staticmethod
+    def _sweep_geometry(Lq: int, max_nm: int, max_n: int):
+        """Sweep bound and vote-kernel query width, both multiples of
+        128 (the Pallas kernels chunk/flush at 128-lane granularity and
+        statically require it). Shared by :meth:`run` and
+        :meth:`warmup_async` like :meth:`_bucket_geometry`."""
+        steps = -(-min(-(-max_nm // 128) * 128, 2 * Lq) // 128) * 128
+        Lq2 = min(Lq, -(-max_n // 128) * 128)
+        return steps, Lq2
+
+    # ------------------------------------------------------------- warm-up
+
+    def warmup_async(self, window_length: int, est_pairs: int,
+                     est_windows: int, est_layer_len: int = 0):
+        """Background warm-up compilation of the expected refinement-loop
+        shape. The first consensus compile (~16 s) used to land inside
+        ``polish()``; ``Polisher.initialize`` calls this on a thread
+        while it aligns overlaps, so ``polish()`` starts hot.
+
+        Derives the same static geometry :meth:`run` computes (band/L
+        from the window length, batch/window paddings from the pair and
+        window count estimates) and executes the jitted loop once on
+        zero state — ``win_real`` is all-false, so the device loop exits
+        before round 1 and the call costs exactly one compile (which the
+        persistent XLA cache then also remembers across runs). A wrong
+        estimate wastes a background compile and nothing else: run()'s
+        own shapes still compile on first use. Returns the thread (for
+        tests), or None when skipped (mesh runs, zero estimates)."""
+        if self.mesh is not None or est_pairs <= 0:
+            return None
+        band, L, Lq, Lb = self._bucket_geometry(window_length)
+        est_layer_len = min(est_layer_len or window_length + 64, Lq)
+        max_nm = est_layer_len + min(est_layer_len + 64, Lb)
+        steps, Lq2 = self._sweep_geometry(Lq, max_nm, est_layer_len)
+        n_groups = max(self.num_batches, -(-est_pairs // MAX_GROUP_PAIRS))
+        B = 1
+        while B < max(1, -(-est_pairs // n_groups)):
+            B *= 2
+        nWp = 1
+        while nWp < max(1, -(-est_windows // n_groups)) + 1:
+            nWp *= 2
+        rounds = (min(self.rounds, STAGE_A_ROUNDS)
+                  if self.rounds > STAGE_A_ROUNDS and n_groups > 1
+                  else self.rounds)
+
+        def _compile():
+            try:
+                # the availability probes themselves compile and run
+                # kernels, so they belong on this thread too — the whole
+                # point is keeping the caller's critical path clear
+                from .swar import swar_fits, swar_ok
+                sw = self.use_swar and swar_fits(Lq) and swar_ok()
+                use_pallas = self._use_pallas((Lq, band, steps, Lb, Lq2))
+                if use_pallas:
+                    from .pallas_nw import pallas_swar_ok
+                    sw = sw and pallas_swar_ok()
+                static = (jnp.zeros((B,), jnp.int32),
+                          jnp.zeros((B, Lq), jnp.uint16),
+                          jnp.full((B,), nWp - 1, jnp.int32),
+                          jnp.zeros((B,), bool))
+                state = (jnp.zeros((B,), jnp.int32),
+                         jnp.zeros((B,), jnp.int32),
+                         jnp.zeros((nWp, Lb), jnp.uint8),
+                         jnp.zeros((nWp, Lb), jnp.float32),
+                         jnp.zeros((nWp,), jnp.int32),
+                         jnp.zeros((nWp, Lb), jnp.int32),
+                         jnp.zeros((nWp,), bool),
+                         jnp.zeros((nWp,), bool),
+                         jnp.zeros((nWp,), bool),
+                         jnp.zeros((1, 4), jnp.int32))
+                out = _refine_loop_packed(
+                    *static, *state, jnp.float32(self.ins_theta),
+                    jnp.float32(self.del_beta), rounds=rounds,
+                    n_windows=nWp, max_len=Lq, band=band, Lb=Lb,
+                    K=K_INS, steps=steps, use_pallas=use_pallas,
+                    use_swar=sw, Lq2=Lq2, scores=self.scores)
+                jax.block_until_ready(out[10])
+            except Exception:
+                pass  # warm-up is an optimization, never fatal
+
+        import threading
+        self._warmup = threading.Thread(target=_compile, daemon=True,
+                                        name="racon-tpu-warmup")
+        self._warmup.start()
+        return self._warmup
+
     # -------------------------------------------------------------- device
 
     def _pack_shard(self, items, Lq, B, nWp, Lb, overrides=None):
@@ -958,8 +1082,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         its refined backbone and remapped spans instead of restarting.
         """
         n = np.ones(B, np.int32)
-        qcodes = np.zeros((B, Lq), np.uint8)
-        qweights = np.zeros((B, Lq), np.uint8)
+        # packed layer lanes: weight << 3 | code per base (codes 3 bits,
+        # phred weights <= 93 in 7) — codes and weights travel as ONE
+        # uint16 array, the format both vote emitters consume directly
+        qpw = np.zeros((B, Lq), np.uint16)
         bg = np.zeros(B, np.int32)
         ed = np.zeros(B, np.int32)
         win_of = np.full(B, nWp - 1, np.int32)  # padding -> sink window
@@ -989,17 +1115,19 @@ class TpuPoaConsensus(PallasDispatchMixin):
             pos = np.arange(Lq)[None, :]
             valid = pos < lens[:, None]
             src = starts[:, None] + np.minimum(pos, lens[:, None] - 1)
-            qcodes[:k] = np.where(valid, codes_cat[src], 0).astype(np.uint8)
 
             qual_cat = np.frombuffer(
                 b"".join((t[2] if t[2] is not None else b"\x22" * len(t[1]))
                          for t in layers), np.uint8)
-            # integral uint8 weights: phred-33 (clipped at 0 — a quality
-            # byte below '!' would otherwise wrap) or 1 for no-quality
+            # integral weights: phred-33 (clipped at 0 — a quality byte
+            # below '!' would otherwise wrap) or 1 for no-quality
             weights = np.maximum(qual_cat[src].astype(np.int16) - 33, 0)
             has_q = np.array([t[2] is not None for t in layers])
             weights = np.where(has_q[:, None], weights, 1)
-            qweights[:k] = np.where(valid, weights, 0).astype(np.uint8)
+            qpw[:k] = np.where(
+                valid,
+                (weights.astype(np.uint16) << 3) | codes_cat[src],
+                0).astype(np.uint16)
 
         bcodes = np.zeros((nWp, Lb), np.uint8)
         bweights = np.zeros((nWp, Lb), np.float32)
@@ -1035,7 +1163,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     ed[off:off + kw] = st_ed
                 off += kw
 
-        return (n, qcodes, qweights, win_of, real, bg, ed), \
+        return (n, qpw, win_of, real, bg, ed), \
                (bcodes, bweights, blen, covs, ever)
 
     def _launch_group(self, live, Lq, Lb, overrides=None):
@@ -1063,7 +1191,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         packs = [self._pack_shard(sh, Lq, B, nWp, Lb, overrides)
                  for sh in shards]
         pair_np = [np.concatenate([p[0][a] for p in packs])
-                   for a in range(7)]
+                   for a in range(6)]
         win_np = [np.concatenate([p[1][a] for p in packs])
                   for a in range(5)]
         # single-host: plain device puts; multi-host: every process packs
@@ -1072,8 +1200,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
         from ..parallel import to_global
         put = ((lambda a: to_global(self.mesh, a)) if self.mesh is not None
                else jnp.asarray)
-        static = tuple(put(a) for a in pair_np[:5])   # n..real
-        bg, ed = (put(pair_np[5]), put(pair_np[6]))
+        static = tuple(put(a) for a in pair_np[:4])   # n, qpw, win_of, real
+        bg, ed = (put(pair_np[4]), put(pair_np[5]))
         bcodes, bweights, blen, covs, ever = (put(a) for a in win_np)
         zput = (lambda a: put(np.asarray(a)))
         frozen = zput(np.zeros(nd * nWp, bool))
@@ -1096,17 +1224,37 @@ class TpuPoaConsensus(PallasDispatchMixin):
         instead of aborting the polish (jit compilation is eager, so
         only compile errors are catchable here; numerics are covered by
         the probe's bit-exact comparison)."""
-        shape_key = (Lq, launch.get("band", self.band), steps, Lb, Lq2)
-        if self._use_pallas(shape_key):
+        from .swar import swar_fits, swar_ok
+        sw = self.use_swar and swar_fits(Lq) and swar_ok()
+        base_key = (Lq, launch.get("band", self.band), steps, Lb, Lq2)
+        swar_key = base_key + ("swar",)
+        if self._use_pallas(base_key):
+            from .pallas_nw import pallas_swar_ok
+            sw_p = (sw and pallas_swar_ok()
+                    and self._use_pallas(swar_key))
+            key = swar_key if sw_p else base_key
             try:
-                self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, True)
+                self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, True,
+                                      sw_p)
+                launch["pallas_key"] = key  # blamed on a fetch fault
                 return
             except Exception as e:
-                self._note_pallas_failure(shape_key, e)
-        self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, False)
+                self._note_pallas_failure(key, e)
+                # a packed-kernel-only fault must not cost the whole
+                # Pallas path: retry the int32 Mosaic kernels first
+                if sw_p and self._use_pallas(base_key):
+                    try:
+                        self._dispatch_rounds(launch, Lq, Lb, steps,
+                                              Lq2, True, False)
+                        launch["pallas_key"] = base_key
+                        return
+                    except Exception as e2:
+                        self._note_pallas_failure(base_key, e2)
+        launch["pallas_key"] = None
+        self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, False, sw)
 
     def _dispatch_rounds(self, launch, Lq, Lb, steps, Lq2,
-                         use_pallas) -> None:
+                         use_pallas, use_swar=False) -> None:
         static, state = launch["static"], launch["state"]
         rounds = launch.get("rounds", self.rounds)
         band = launch.get("band", self.band)
@@ -1120,7 +1268,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 *static, *state, theta, beta, rounds=rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-                Lq2=Lq2, scores=self.scores)
+                use_swar=use_swar, Lq2=Lq2, scores=self.scores)
             launch["state"] = list(out[:10])
             launch["fetch2"] = out[10:12]
         else:
@@ -1129,7 +1277,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 self.mesh, static, state, theta, beta, rounds=rounds,
                 n_windows_local=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-                Lq2=Lq2, scores=self.scores)
+                use_swar=use_swar, Lq2=Lq2, scores=self.scores)
             launch["state"] = list(out)
 
     def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
@@ -1203,7 +1351,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
             if retried:
                 raise
             self._note_pallas_failure(
-                (Lq, launch.get("band", self.band), steps, Lb, Lq2), e)
+                launch.get("pallas_key")
+                or (Lq, launch.get("band", self.band), steps, Lb, Lq2), e)
             live = [item for sh in shards for item in sh]
             relaunch = self._launch_group(live, Lq, Lb,
                                           overrides=launch["overrides"])

@@ -1,0 +1,181 @@
+"""SWAR (SIMD-within-a-register) primitives for the packed DP kernels.
+
+The round-5 telemetry showed the DP kernels using <2% of the VPU: int32
+vector lanes carry 2-bit bases and scores that are provably bounded by
+the alignment band. Two packed formats recover the wasted lane width:
+
+- **int16x2 score lanes**: wavefront scores are bounded by
+  ``max(n, m) <= max_len`` (every banded-NW cell is an edit distance of a
+  prefix pair), so two scores share one 32-bit lane. The XLA kernels use
+  the ``int16`` dtype directly (the VPU/AVX vectorizer packs two values
+  per 32-bit lane); the Pallas kernel packs explicitly into int32 words
+  (planar halves, see ``pallas_nw._fwd_kernel_swar``) and runs min/select
+  with the **biased-unsigned** halfword trick below, so per-lane min/add
+  never borrows across the halfword boundary.
+- **2-bit bases**: when a chunk's alphabet fits 4 symbols (ACGT does),
+  bases travel host->device 4 per byte (16 per int32 word) and equality
+  runs as XOR + mask instead of per-byte compares.
+
+Saturation ceiling: packed scores saturate at ``BIG16`` (the int16 analog
+of the int32 kernels' ``1 << 28``). Any band/length combination whose
+real scores could reach ``BIG16`` must re-dispatch to the int32 path —
+:func:`swar_fits` is that overflow guard (all current buckets fit:
+``max_len <= 16384 < BIG16``).
+
+Bit-exactness contract (relied on by the goldens): for the same input
+rows, the packed kernels emit **byte-identical direction matrices and
+scores** — real scores are < ``BIG16`` in both paths, the saturated
+cells form the same {BIG, BIG+1} classes, and every comparison the
+direction code depends on sees the same ordering. :func:`swar_ok` probes
+this once per process on a random batch (the same philosophy as
+``pallas_nw.pallas_ok``) and the dispatch layers fall back to int32 when
+it fails.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+# Saturation value for packed int16 score lanes. Must exceed every real
+# cell value (<= max_len, see module docstring) and keep BIG16 + 1 inside
+# int16 (boundary cells add +1 per step off a saturated source). 0x4800
+# leaves 2x headroom over the largest bucket (16384).
+BIG16 = 0x4800
+# int32 analog restored on the way out so consumers (and the parity
+# harness) see the exact int32-path scores.
+BIG32 = 1 << 28
+
+# Halfword SWAR constants (int32 words carrying two unsigned 16-bit
+# fields whose values stay < 2^15, so bit 15 of each field is a free
+# guard bit for borrow-free compares).
+ONES16 = int(np.int32(0x00010001))
+TWOS16 = int(np.int32(0x00020002))
+# guard-bit mask 0x80008000 as a (negative) int32
+H16 = int(np.uint32(0x80008000).view(np.int32))
+LO16 = int(np.int32(0x0000FFFF))
+
+
+def swar16_ge(a, b):
+    """Per-halfword full-field mask (0xFFFF) where ``a >= b``.
+
+    Both operands' fields must be unsigned values < 2^15 (guard bit 15
+    clear). Biased-unsigned compare: ``(a | H) - b`` adds 2^15 to each
+    field before subtracting, so the per-field result stays in 16 bits
+    and no borrow crosses the halfword boundary; field bit 15 then reads
+    ``a >= b``. The shift is arithmetic (int32) — the ``& ONES16`` mask
+    discards the sign smear before the mask-expansion multiply."""
+    m = ((a | H16) - b) & H16
+    return ((m >> 15) & ONES16) * LO16
+
+
+def swar16_sel(a, b, m):
+    """Per-halfword select: ``a`` where the full-field mask ``m`` is set,
+    else ``b`` (masks come from :func:`swar16_ge` / :func:`swar16_eq`)."""
+    return (a & m) | (b & ~m)
+
+
+def swar16_min(a, b):
+    """Per-halfword minimum (fields < 2^15): keep ``b`` where a >= b."""
+    return swar16_sel(b, a, swar16_ge(a, b))
+
+
+def swar16_eq(a, b):
+    """Per-halfword full-field mask where ``a == b`` (fields < 2^15):
+    XOR + or-tree nonzero detect, inverted, expanded to field masks."""
+    x = a ^ b
+    t = x | (x >> 8)
+    t = t | (t >> 4)
+    t = t | (t >> 2)
+    t = t | (t >> 1)
+    return ((t & ONES16) ^ ONES16) * LO16
+
+
+def swar16_ne_small(x, bits: int = 4):
+    """Per-halfword 0/1 nonzero detect for XOR results of codes < 2^bits
+    (the SWAR base-equality substitute for a per-byte compare): cross-
+    field shift contamination lands above bit ``bits`` and is masked."""
+    t = x
+    sh = 1
+    while sh < bits:
+        t = t | (t >> sh)
+        sh *= 2
+    return t & ONES16
+
+
+def swar_fits(max_len: int) -> bool:
+    """Overflow guard: True when every cell value a ``max_len`` bucket can
+    produce (boundary values <= max_len, interior edit distances
+    <= max(i, j) <= max_len, +1 per step of saturated-source slack) stays
+    strictly below the packed saturation ceiling. Combinations that fail
+    re-dispatch to the int32 path."""
+    return max_len + 2 < BIG16
+
+
+_SWAR_OK = None
+
+
+def swar_ok() -> bool:
+    """Probe once whether the packed (int16-lane) XLA wavefront kernel
+    reproduces the int32 kernel bit-for-bit on a random small batch —
+    dirs, scores, and walked tracebacks. Mirrors ``pallas_ok()``: a
+    backend whose 16-bit lowering misbehaves downgrades to the int32
+    kernels instead of shipping corrupt alignments."""
+    global _SWAR_OK
+    import os
+    if os.environ.get("RACON_TPU_SWAR", "1") == "0":
+        return False  # global escape hatch / A-B switch, like DYNBOUND
+    if _SWAR_OK is None:
+        try:
+            from .nw import _nw_wavefront_kernel, _walk_ops_kernel
+
+            max_len, band = 256, 128
+            B, c = 8, band // 2
+            width = c + max_len + band
+            rng = np.random.default_rng(13)
+            bases = np.frombuffer(b"ACGT", np.uint8)
+            qrp = np.zeros((B, width), np.uint8)
+            tp = np.zeros((B, width), np.uint8)
+            n = np.zeros(B, np.int32)
+            m = np.zeros(B, np.int32)
+            for k in range(B):
+                ln = int(rng.integers(50, 220))
+                t = bases[rng.integers(0, 4, ln)]
+                q = np.delete(t.copy(), rng.integers(0, ln, 3))
+                flips = rng.random(len(q)) < 0.2
+                q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+                qrp[k, c + max_len - len(q): c + max_len] = q[::-1]
+                tp[k, c: c + ln] = t
+                n[k], m[k] = len(q), ln
+            args = (jnp.asarray(qrp), jnp.asarray(tp),
+                    jnp.asarray(n), jnp.asarray(m))
+            dp, sp = _nw_wavefront_kernel(*args, max_len=max_len,
+                                          band=band, swar=True)
+            dx, sx = _nw_wavefront_kernel(*args, max_len=max_len,
+                                          band=band)
+            op_, fip, fjp = _walk_ops_kernel(dp, args[2], args[3],
+                                             band=band)
+            ox, fix, fjx = _walk_ops_kernel(dx, args[2], args[3],
+                                            band=band)
+            _SWAR_OK = (
+                np.array_equal(np.asarray(dp), np.asarray(dx))
+                and np.array_equal(np.asarray(sp), np.asarray(sx))
+                and np.array_equal(np.asarray(op_), np.asarray(ox))
+                and np.array_equal(np.asarray(fip), np.asarray(fix))
+                and np.array_equal(np.asarray(fjp), np.asarray(fjx)))
+        except Exception:
+            _SWAR_OK = False
+    return _SWAR_OK
+
+
+def pack_bases_2bit(codes: np.ndarray) -> np.ndarray:
+    """Host-side 2-bit base packing: 4 codes per byte (16 per int32
+    word), LSB-first. ``codes`` values must be < 4; length is padded to a
+    multiple of 4. The device unpacker is ``nw._build_rows_packed2``."""
+    pad = (-len(codes)) % 4
+    if pad:
+        codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+    c4 = codes.reshape(-1, 4)
+    return (c4[:, 0] | (c4[:, 1] << 2) | (c4[:, 2] << 4)
+            | (c4[:, 3] << 6)).astype(np.uint8)

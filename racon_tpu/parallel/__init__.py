@@ -37,6 +37,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "d"
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` moved between releases (``jax.shard_map`` with
+    ``check_vma`` on current JAX; ``jax.experimental.shard_map.shard_map``
+    with ``check_rep`` on 0.4.x) — one compat shim so every engine wires
+    through identical code."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def distributed_init(coordinator_address: str, num_processes: int,
                      process_id: int) -> None:
     """Join a multi-host JAX job (idempotent): after this,
@@ -118,22 +131,23 @@ def partition_balanced(costs: Sequence[int], n_bins: int) -> List[List[int]]:
 
 @functools.lru_cache(maxsize=None)
 def _sharded_align_fn(mesh: Mesh, max_len: int, band: int, steps: int,
-                      use_pallas: bool):
+                      use_pallas: bool, use_swar: bool):
     from ..ops.nw import align_chain
 
     def local(qrp, tp, n, m):
         return align_chain(qrp, tp, n, m, max_len=max_len, band=band,
-                           steps=steps, use_pallas=use_pallas)
+                           steps=steps, use_pallas=use_pallas,
+                           use_swar=use_swar)
 
     spec = P(AXIS)
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(spec, spec, spec, spec),
-                                 out_specs=(spec, spec, spec, spec),
-                                 check_vma=False))
+    return jax.jit(_shard_map(local, mesh,
+                              in_specs=(spec, spec, spec, spec),
+                              out_specs=(spec, spec, spec, spec)))
 
 
 def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int,
-                  steps: int = 0, use_pallas: bool = False):
+                  steps: int = 0, use_pallas: bool = False,
+                  use_swar: bool = False):
     """NW + traceback with the batch dimension split over ``mesh``.
 
     Batch size must be a multiple of the mesh size (callers pad).
@@ -141,43 +155,46 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int,
     ``_traceback_kernel``.
     """
     return _sharded_align_fn(mesh, max_len, band, steps,
-                             use_pallas)(qrp, tp, n, m)
+                             use_pallas, use_swar)(qrp, tp, n, m)
 
 
 @functools.lru_cache(maxsize=None)
 def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
                        max_len: int, band: int, Lb: int, K: int,
-                       steps: int, use_pallas: bool, Lq2: int, scores):
+                       steps: int, use_pallas: bool, use_swar: bool,
+                       Lq2: int, scores):
     from ..ops.poa import refine_loop
 
-    def local(n, qcodes, qweights, win_of, real, bg, ed,
+    def local(n, qpw, win_of, real, bg, ed,
               bcodes, bweights, blen, covs, ever, frozen, conv, dropped,
               ins_theta, del_beta):
-        return refine_loop(n, qcodes, qweights, win_of, real, bg, ed,
+        return refine_loop(n, qpw, win_of, real, bg, ed,
                            bcodes, bweights, blen, covs, ever, frozen,
                            conv, dropped, ins_theta, del_beta,
                            rounds=rounds,
                            n_windows=n_windows_local, max_len=max_len,
                            band=band, Lb=Lb, K=K, steps=steps,
-                           use_pallas=use_pallas, Lq2=Lq2, scores=scores)
+                           use_pallas=use_pallas, use_swar=use_swar,
+                           Lq2=Lq2, scores=scores)
 
     spec = P(AXIS)
-    return jax.jit(jax.shard_map(
-        local, mesh=mesh, in_specs=(spec,) * 15 + (P(), P()),
-        out_specs=(spec,) * 10, check_vma=False))
+    return jax.jit(_shard_map(
+        local, mesh, in_specs=(spec,) * 14 + (P(), P()),
+        out_specs=(spec,) * 10))
 
 
 def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
                         rounds: int, n_windows_local: int, max_len: int,
                         band: int, Lb: int, K: int, steps: int = 0,
-                        use_pallas: bool = False, Lq2: int = 0,
-                        scores=(3, -5, -4)):
+                        use_pallas: bool = False, use_swar: bool = False,
+                        Lq2: int = 0, scores=(3, -5, -4)):
     """A group's whole refinement loop over a co-sharded batch, one
     dispatch (the shard-local body is ``refine_loop``'s fori over
     ``refine_round``).
 
-    ``static`` = (n, qcodes, qweights, win_of, real) with leading dim
-    ``n_shards * B_local``; ``win_of`` holds **shard-local** window
+    ``static`` = (n, qpw, win_of, real) with leading dim
+    ``n_shards * B_local`` (``qpw`` is the packed ``weight << 3 | code``
+    uint16 layer block); ``win_of`` holds **shard-local** window
     ordinals.  ``state`` = (bg, ed, bcodes, bweights, blen, covs, ever,
     frozen, conv, dropped) — pair-major arrays share the pair stacking, window
     rows have leading dim ``n_shards * n_windows_local``, ``dropped`` is
@@ -190,5 +207,6 @@ def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
     the updated ``state`` stacked the same way.
     """
     fn = _sharded_refine_fn(mesh, rounds, n_windows_local, max_len, band,
-                            Lb, K, steps, use_pallas, Lq2, scores)
+                            Lb, K, steps, use_pallas, use_swar, Lq2,
+                            scores)
     return fn(*static, *state, ins_theta, del_beta)

@@ -1,0 +1,414 @@
+"""SWAR-packed kernel parity harness (runs on the CPU XLA backend via
+conftest; the same code paths run on TPU).
+
+The packed paths (int16x2 score lanes, 2-bit bases, packed qpw layer
+lanes, the widened insertion accumulator) must be **bit-exact** against
+the int32 paths — scores, direction matrices, tracebacks, breaking
+points and consensus bytes all equal. These tests are the tier-1 gate
+for that contract (wired as a dedicated shard in ci/cpu/test.sh)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from racon_tpu.ops import swar
+from racon_tpu.ops.nw import (_build_rows_packed, _build_rows_packed2,
+                              _nw_wavefront_kernel, _walk_ops_kernel,
+                              TpuAligner)
+
+BASES = np.frombuffer(b"ACGT", np.uint8)
+
+
+# ------------------------------------------------------------ primitives
+
+def _fields(x):
+    x = np.asarray(x).astype(np.int64)
+    return x & 0xFFFF, (x >> 16) & 0xFFFF
+
+
+def test_swar16_primitives_match_per_field_reference():
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 1 << 15, 8192).astype(np.int32)
+    b = rng.integers(0, 1 << 15, 8192).astype(np.int32)
+    ap = jnp.asarray(a[0::2] | (a[1::2] << 16))
+    bp = jnp.asarray(b[0::2] | (b[1::2] << 16))
+
+    lo, hi = _fields(swar.swar16_ge(ap, bp))
+    assert np.array_equal(lo, (a[0::2] >= b[0::2]) * 0xFFFF)
+    assert np.array_equal(hi, (a[1::2] >= b[1::2]) * 0xFFFF)
+
+    lo, hi = _fields(swar.swar16_min(ap, bp))
+    assert np.array_equal(lo, np.minimum(a[0::2], b[0::2]))
+    assert np.array_equal(hi, np.minimum(a[1::2], b[1::2]))
+
+    lo, hi = _fields(swar.swar16_eq(ap, bp))
+    assert np.array_equal(lo, (a[0::2] == b[0::2]) * 0xFFFF)
+    assert np.array_equal(hi, (a[1::2] == b[1::2]) * 0xFFFF)
+
+    # XOR + mask equality on 4-bit codes
+    c = rng.integers(0, 16, 8192).astype(np.int32)
+    d = rng.integers(0, 16, 8192).astype(np.int32)
+    cp = jnp.asarray(c[0::2] | (c[1::2] << 16))
+    dp = jnp.asarray(d[0::2] | (d[1::2] << 16))
+    lo, hi = _fields(swar.swar16_ne_small(cp ^ dp, 4))
+    assert np.array_equal(lo, (c[0::2] != d[0::2]).astype(np.int64))
+    assert np.array_equal(hi, (c[1::2] != d[1::2]).astype(np.int64))
+
+
+def test_swar_probe_and_overflow_guard():
+    assert swar.swar_ok()
+    assert swar.swar_fits(16384)       # every current bucket
+    assert not swar.swar_fits(32768)   # a hypothetical 32k bucket
+
+
+# --------------------------------------------------------- kernel parity
+
+def _pack_batch(pairs, max_len, band):
+    c = band // 2
+    width = c + max_len + band
+    B = len(pairs)
+    qrp = np.zeros((B, width), np.uint8)
+    tp = np.zeros((B, width), np.uint8)
+    n = np.zeros(B, np.int32)
+    m = np.zeros(B, np.int32)
+    for k, (q, t) in enumerate(pairs):
+        qrp[k, c + max_len - len(q): c + max_len] = q[::-1]
+        tp[k, c: c + len(t)] = t
+        n[k], m[k] = len(q), len(t)
+    return (jnp.asarray(qrp), jnp.asarray(tp), jnp.asarray(n),
+            jnp.asarray(m)), n, m
+
+
+def _assert_kernel_parity(pairs, max_len, band, steps=0):
+    args, n, m = _pack_batch(pairs, max_len, band)
+    dp, sp = _nw_wavefront_kernel(*args, max_len=max_len, band=band,
+                                  steps=steps, swar=True)
+    dx, sx = _nw_wavefront_kernel(*args, max_len=max_len, band=band,
+                                  steps=steps)
+    assert np.array_equal(np.asarray(dp), np.asarray(dx))
+    assert np.array_equal(np.asarray(sp), np.asarray(sx))
+    op_p, fip, fjp = _walk_ops_kernel(dp, args[2], args[3], band=band)
+    op_x, fix, fjx = _walk_ops_kernel(dx, args[2], args[3], band=band)
+    assert np.array_equal(np.asarray(op_p), np.asarray(op_x))
+    assert np.array_equal(np.asarray(fip), np.asarray(fix))
+    assert np.array_equal(np.asarray(fjp), np.asarray(fjx))
+
+
+def _mutated_pair(rng, ln, err, ndel=4, nins=4):
+    t = BASES[rng.integers(0, 4, ln)]
+    q = t.copy()
+    flips = rng.random(ln) < err
+    q[flips] = BASES[rng.integers(0, 4, int(flips.sum()))]
+    q = np.delete(q, rng.integers(0, len(q), ndel))
+    q = np.insert(q, rng.integers(0, len(q), nins),
+                  BASES[rng.integers(0, 4, nins)])
+    return q, t
+
+
+def test_randomized_1k_pair_parity_sweep():
+    """The acceptance-criteria sweep: 1k random pairs, packed vs int32 —
+    scores, direction matrices and walked tracebacks all bit-equal."""
+    rng = np.random.default_rng(41)
+    pairs = [_mutated_pair(rng, int(rng.integers(16, 240)),
+                           float(rng.uniform(0.0, 0.35)))
+             for _ in range(1000)]
+    _assert_kernel_parity(pairs, max_len=256, band=128)
+
+
+def test_band_edge_saturation_parity():
+    """Pairs engineered to escape the band (structural rearrangement)
+    keep score BIG in both paths and produce identical dirs — the
+    saturation classes {BIG, BIG+1} line up across the encodings."""
+    rng = np.random.default_rng(42)
+    pairs = []
+    for _ in range(16):
+        ln = int(rng.integers(150, 250))
+        t = BASES[rng.integers(0, 4, ln)]
+        q = np.concatenate([t[ln // 2:], t[:ln // 2]])  # off-diagonal
+        pairs.append((q, t))
+    args, n, m = _pack_batch(pairs, 256, 128)
+    dp, sp = _nw_wavefront_kernel(*args, max_len=256, band=128, swar=True)
+    dx, sx = _nw_wavefront_kernel(*args, max_len=256, band=128)
+    assert np.array_equal(np.asarray(dp), np.asarray(dx))
+    assert np.array_equal(np.asarray(sp), np.asarray(sx))
+    assert np.asarray(sp).max() >= 128 // 2  # at least one real escape
+
+
+def test_odd_lane_counts_and_bucket_boundaries():
+    """Odd (unpaired) batch rows and n/m pinned at the bucket caps: the
+    packed path must agree where lengths sit exactly on max_len, on the
+    steps bound, and at zero."""
+    rng = np.random.default_rng(43)
+    max_len = 256
+    full = BASES[rng.integers(0, 4, max_len)]
+    fullq = full.copy()
+    flips = rng.random(max_len) < 0.1
+    fullq[flips] = BASES[rng.integers(0, 4, int(flips.sum()))]
+    pairs = [
+        (fullq, full),                    # n = m = max_len (hits steps)
+        (full[:0], full[:7]),             # n = 0
+        (full[:7], full[:0]),             # m = 0
+        (full[:1], full[:1]),             # minimal
+        (fullq[:max_len - 1], full),      # one off the cap
+        (full, full),                     # identity at the cap
+        (fullq[:129], full[:128]),        # straddling band/2
+    ]  # 7 rows: odd count, not a power of two
+    _assert_kernel_parity(pairs, max_len=max_len, band=128)
+
+
+def test_aligner_end_to_end_swar_parity():
+    """TpuAligner with and without SWAR: identical CIGARs and breaking
+    points, including an N-bearing batch (alphabet > 4 symbols falls
+    back to the nibble pack) and band-escalation pairs."""
+    from racon_tpu.core.backends import PythonAligner
+
+    rng = np.random.default_rng(44)
+    pairs, metas = [], []
+    for k in range(48):
+        q, t = _mutated_pair(rng, int(rng.integers(60, 240)),
+                             0.3 if k % 7 == 0 else 0.1)
+        if k % 5 == 0:  # sprinkle Ns -> 5-symbol alphabet chunks
+            q = q.copy()
+            q[rng.integers(0, len(q), 3)] = ord("N")
+        pairs.append((q.tobytes(), t.tobytes()))
+        metas.append((int(rng.integers(0, 500)), int(rng.integers(0, 200))))
+    a_sw = TpuAligner(fallback=PythonAligner())
+    a_32 = TpuAligner(fallback=PythonAligner(), use_swar=False)
+    assert a_sw.align_batch(pairs) == a_32.align_batch(pairs)
+    assert (a_sw.breaking_points_batch(pairs, metas, 64)
+            == a_32.breaking_points_batch(pairs, metas, 64))
+    assert a_sw.stats["swar_chunks"] > 0
+    assert a_32.stats["swar_chunks"] == 0
+
+
+def test_build_rows_packed2_matches_nibble_rows():
+    """The 2-bit row builder must place exactly the bytes the nibble
+    builder places (same codes modulo the encoding bijection) at every
+    in-range position; out-of-range lanes are pad in both."""
+    from racon_tpu.ops.swar import pack_bases_2bit
+
+    rng = np.random.default_rng(45)
+    max_len, band = 256, 128
+    B = 8
+    codes = rng.integers(0, 4, (B, max_len)).astype(np.uint8)
+    n = rng.integers(1, max_len + 1, B).astype(np.int32)
+    m = rng.integers(1, max_len + 1, B).astype(np.int32)
+    flat = codes.reshape(-1)
+    q2 = pack_bases_2bit(flat)
+    # nibble encoding of the same data shifted +1 (nibble code 0 is pad)
+    q4 = (flat + 1).astype(np.uint8)
+    q4 = q4[0::2] | (q4[1::2] << 4)
+    nd, md = jnp.asarray(n), jnp.asarray(m)
+    qr2, tp2 = _build_rows_packed2(jnp.asarray(q2), jnp.asarray(q2),
+                                   nd, md, max_len=max_len, band=band)
+    qr4, tp4 = _build_rows_packed(jnp.asarray(q4), jnp.asarray(q4),
+                                  nd, md, max_len=max_len, band=band)
+    qr4 = np.asarray(qr4).astype(np.int16)
+    tp4 = np.asarray(tp4).astype(np.int16)
+    # in-range lanes: code2 == code4 - 1; pad lanes are 0 in both
+    assert np.array_equal(np.asarray(qr2),
+                          np.where(qr4 > 0, qr4 - 1, 0).astype(np.uint8))
+    assert np.array_equal(np.asarray(tp2),
+                          np.where(tp4 > 0, tp4 - 1, 0).astype(np.uint8))
+
+
+def test_pallas_swar_kernel_interpret_parity():
+    """The explicit int32-word SWAR Mosaic kernel, executed in Pallas
+    interpret mode (the only way to run it off-TPU): direction matrix
+    and scores bit-equal to the XLA reference. On real hardware the
+    same comparison is `pallas_swar_ok()`."""
+    from jax.experimental import pallas as pl
+    import racon_tpu.ops.pallas_nw as pnw
+
+    rng = np.random.default_rng(50)
+    pairs = [_mutated_pair(rng, int(rng.integers(60, 200)), 0.2)
+             for _ in range(8)]
+    args, n, m = _pack_batch(pairs, 256, 128)
+    orig = pl.pallas_call
+
+    def interpreted(*a, **k):
+        k["interpret"] = True
+        return orig(*a, **k)
+
+    pl.pallas_call = interpreted
+    try:
+        try:
+            dp, sp = pnw.pallas_nw_fwd(*args, max_len=256, band=128,
+                                       out_quant=512, use_swar=True)
+        except Exception as e:  # interpret-mode support varies by jax
+            pytest.skip(f"pallas interpret mode unavailable: {e!r}")
+    finally:
+        pl.pallas_call = orig
+    dx, sx = _nw_wavefront_kernel(*args, max_len=256, band=128)
+    mx = int((n + m).max())
+    assert np.array_equal(np.asarray(dp)[:, :mx], np.asarray(dx)[:, :mx])
+    assert np.array_equal(np.asarray(sp), np.asarray(sx))
+
+
+# ------------------------------------------------------------- consensus
+
+def _consensus_windows(rng, n_w=8, wl=400, depth=10, with_quality=True):
+    from racon_tpu.core.window import Window, WindowType
+
+    windows = []
+    for wi in range(n_w):
+        truth = BASES[rng.integers(0, 4, wl)]
+        bb = truth.copy()
+        flips = rng.random(wl) < 0.1
+        bb[flips] = BASES[rng.integers(0, 4, int(flips.sum()))]
+        win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * wl)
+        for _ in range(depth):
+            layer, _ = _mutated_pair(rng, wl, 0.08, ndel=5, nins=5)
+            qual = (bytes(33 + int(x) for x in
+                          rng.integers(5, 50, len(layer)))
+                    if with_quality else None)
+            win.add_layer(layer.tobytes(), qual, 0, wl - 1)
+        windows.append(win)
+    return windows
+
+
+def _clone_windows(windows):
+    from racon_tpu.core.window import Window
+
+    out = []
+    for w in windows:
+        c = Window(w.id, w.rank, w.type, w.sequences[0], w.qualities[0])
+        for i in range(1, len(w.sequences)):
+            b, e = w.positions[i]
+            c.add_layer(w.sequences[i], w.qualities[i], b, e)
+        out.append(c)
+    return out
+
+
+def test_consensus_swar_parity_bit_exact():
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    rng = np.random.default_rng(46)
+    w1 = _consensus_windows(rng)
+    w2 = _clone_windows(w1)
+    e_sw = TpuPoaConsensus(3, -5, -4)
+    e_32 = TpuPoaConsensus(3, -5, -4, use_swar=False)
+    r1 = e_sw.run(w1, trim=True)
+    r2 = e_32.run(w2, trim=True)
+    assert r1 == r2
+    for a, b in zip(w1, w2):
+        assert a.consensus == b.consensus
+    assert e_sw.stats["device_windows"] == len(w1)
+
+
+def test_insertion_accumulator_deep_window_regression():
+    """Regression for the silent 23-bit-weight / 9-bit-count saturation:
+    more than 511 insertion votes at ONE address must accumulate exactly
+    (the old single-u32 packing carried the count into the weight bits —
+    at 640 votes it wrapped u32 entirely). Covers both the folded and
+    the unfolded scatter paths."""
+    from racon_tpu.ops.poa import CH, _accumulate_votes
+
+    L, K, nW, band = 64, 4, 2, 64
+    addr = (L + 3 * K + 1) * CH + 2   # insertion slot 1 of junction 3
+    for B in (640, 600):              # 640 folds (B % 32 == 0), 600 not
+        S = 16
+        idx = np.full((B, S), L * (1 + K) * CH, np.int32)
+        idx[:, 0] = addr
+        w = np.zeros((B, S), np.int32)
+        w[:, 0] = 9
+        ok = np.ones(B, bool)
+        win_of = np.zeros(B, np.int32)
+        span_m = np.ones(B, np.int32)
+        n = np.full(B, 2, np.int32)
+        score = np.ones(B, np.int32)
+        args = [jnp.asarray(a) for a in
+                (idx, w, ok, win_of, span_m, np.zeros(B, np.int32), n,
+                 score)]
+        weighted, unweighted, ovf = _accumulate_votes(
+            *args, n_windows=nW, L=L, K=K, band=band)
+        # alpha == 64 at default scores: every vote lands as 9 * 64
+        assert float(np.asarray(weighted)[0, addr]) == B * 9 * 64
+        assert int(np.asarray(unweighted)[0, addr]) == B
+        assert int(ovf) == 0
+
+
+def test_max_depth_cap_lifted_past_511():
+    """The 511 voting-depth clamp existed only to protect the 9-bit
+    count field; the widened accumulator moves the ceiling to the f32
+    matmul-exactness bound (2047)."""
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    assert TpuPoaConsensus(3, -5, -4, max_depth=4096).max_depth == 2047
+    assert TpuPoaConsensus(3, -5, -4, max_depth=200).max_depth == 200
+
+
+# --------------------------------------------------------------- warm-up
+
+def test_warmup_async_compiles_and_engine_still_exact():
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    rng = np.random.default_rng(47)
+    eng = TpuPoaConsensus(3, -5, -4)
+    th = eng.warmup_async(64, est_pairs=64, est_windows=8)
+    assert th is not None
+    th.join(timeout=300)
+    assert not th.is_alive()
+    # the engine still produces the exact non-warmed results
+    w1 = _consensus_windows(rng, n_w=4, wl=120, depth=6)
+    w2 = _clone_windows(w1)
+    ref = TpuPoaConsensus(3, -5, -4)
+    assert eng.run(w1, trim=True) == ref.run(w2, trim=True)
+    for a, b in zip(w1, w2):
+        assert a.consensus == b.consensus
+
+
+def test_warmup_skipped_for_empty_estimates():
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    assert TpuPoaConsensus(3, -5, -4).warmup_async(500, 0, 0) is None
+
+
+# ------------------------------------------------------ streaming parser
+
+def test_native_parser_streams_multi_chunk_gzip(tmp_path):
+    """Records spanning the chunked-inflate boundaries (>1 MiB buffer)
+    parse identically to the Python oracle — the bounded-buffer rewrite
+    must not change a byte."""
+    from racon_tpu.io import parsers
+    from racon_tpu import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    import gzip
+
+    rng = np.random.default_rng(48)
+    chunks = []
+    for i in range(300):
+        seq = BASES[rng.integers(0, 4, 12000)].tobytes()
+        qual = bytes(33 + int(x) for x in rng.integers(0, 60, len(seq)))
+        chunks.append(b"@read_%d some description\n%s\n+\n%s\n"
+                      % (i, seq, qual))
+    raw = b"".join(chunks)
+    assert len(raw) > 3 << 20  # several LineReader chunks
+    path = tmp_path / "big.fastq.gz"
+    path.write_bytes(gzip.compress(raw))
+    nat = list(parsers.parse_fastq(str(path)))
+    ora = list(parsers._parse_fastq_py(str(path)))
+    assert len(nat) == len(ora) == 300
+    for a, b in zip(nat, ora):
+        assert (a.name, a.data, a.quality) == (b.name, b.data, b.quality)
+
+
+def test_native_parser_long_single_line_fasta(tmp_path):
+    """A FASTA record on one line longer than the read chunk exercises
+    the rolling buffer's growth path."""
+    from racon_tpu.io import parsers
+    from racon_tpu import native
+
+    if not native.available():
+        pytest.skip("native core unavailable")
+    rng = np.random.default_rng(49)
+    seq = BASES[rng.integers(0, 4, (1 << 20) + 12345)].tobytes()
+    path = tmp_path / "one_line.fasta"
+    path.write_bytes(b">contig_long trailing meta\n" + seq + b"\n")
+    recs = list(parsers.parse_fasta(str(path)))
+    assert len(recs) == 1
+    assert recs[0].name == b"contig_long"
+    assert recs[0].data == seq
