@@ -124,7 +124,7 @@ def bench_aligner():
         warm = min(warm, time.perf_counter() - t0)
     bases_aligned = sum(len(q) for q, _ in pairs)
     log(f"warm (best of 2): {warm:.2f}s ({len(pairs) / warm:.1f} pairs/s)")
-    assert sum(1 for b in bps if b) > 0.9 * len(pairs)
+    assert sum(1 for b in bps if len(b)) > 0.9 * len(pairs)
 
     log("TPU aligner (CIGAR mode) for the host-agreement check...")
     t0 = time.perf_counter()
@@ -352,7 +352,7 @@ def bench_pipeline():
     from racon_tpu.core.polisher import create_polisher
     from racon_tpu import native
 
-    def run_once(mbp_run, seed, backend, batches):
+    def run_once(mbp_run, seed, backend, batches, fused=False):
         t0 = _time.perf_counter()
         reads, paf, contigs, truths = simulate(mbp_run, seed=seed)
         gen_s = _time.perf_counter() - t0
@@ -369,11 +369,18 @@ def bench_pipeline():
                                 consensus_backend=backend,
                                 aligner_batches=batches,
                                 consensus_batches=batches)
-            p.initialize()
-            init_s = _time.perf_counter() - t0
-            t0 = _time.perf_counter()
-            polished = p.polish(drop_unpolished_sequences=True)
-            polish_s = _time.perf_counter() - t0
+            if fused:
+                # pipelined surface: window build streams into consensus
+                polished = p.run(drop_unpolished_sequences=True)
+                init_s = polish_s = 0.0
+                total_s = _time.perf_counter() - t0
+            else:
+                p.initialize()
+                init_s = _time.perf_counter() - t0
+                t0 = _time.perf_counter()
+                polished = p.polish(drop_unpolished_sequences=True)
+                polish_s = _time.perf_counter() - t0
+                total_s = init_s + polish_s
         stats = {}
         for eng in (p.aligner, p.consensus):
             for k, v in getattr(eng, "stats", {}).items():
@@ -388,15 +395,35 @@ def bench_pipeline():
         err_before = native.edit_distance(draft0[:probe],
                                           truths[0][:probe])
         return dict(gen_s=gen_s, init_s=init_s, polish_s=polish_s,
-                    total_s=init_s + polish_s, stats=stats,
+                    total_s=total_s, stats=stats, timings=dict(p.timings),
                     err_after=err_after, err_before=err_before,
-                    probe=probe, n_polished=len(polished))
+                    probe=probe, n_polished=len(polished), pol0=pol0)
 
     log(f"pipeline bench: {mbp} Mbp TPU full pipeline...")
     tpu = run_once(mbp, seed=23, backend="tpu", batches=4)
     log(f"pipeline tpu: init {tpu['init_s']:.1f}s + polish "
         f"{tpu['polish_s']:.1f}s = {tpu['total_s']:.1f}s "
-        f"({mbp / tpu['total_s']:.3f} Mbp/s), stats={tpu['stats']}")
+        f"({mbp / tpu['total_s']:.3f} Mbp/s), stats={tpu['stats']}, "
+        f"init breakdown={tpu['timings']}")
+    # fused A/B (RACON_TPU_BENCH_FUSED=0 disables): the same workload
+    # through run() — init->polish pipelined; polished bytes must be
+    # IDENTICAL to the split surface (scale-sized bit-parity check)
+    fused_metrics = {}
+    if os.environ.get("RACON_TPU_BENCH_FUSED", "1") != "0":
+        log(f"pipeline bench: {mbp} Mbp TPU fused (pipelined) run...")
+        fused = run_once(mbp, seed=23, backend="tpu", batches=4,
+                         fused=True)
+        assert fused["pol0"] == tpu["pol0"], \
+            "fused run() diverged from initialize()+polish()"
+        log(f"pipeline fused: {fused['total_s']:.1f}s "
+            f"({mbp / fused['total_s']:.3f} Mbp/s, split was "
+            f"{tpu['total_s']:.1f}s)")
+        fused_metrics = {
+            "pipeline_fused_total_s": round(fused["total_s"], 2),
+            "pipeline_fused_mbp_per_sec": round(mbp / fused["total_s"], 4),
+            "pipeline_fused_vs_split": round(
+                tpu["total_s"] / fused["total_s"], 3),
+        }
     cpu_mbp = min(1.0, mbp)
     log(f"pipeline bench: {cpu_mbp} Mbp CPU-engine baseline...")
     cpu = run_once(cpu_mbp, seed=29, backend="cpu", batches=1)
@@ -411,7 +438,12 @@ def bench_pipeline():
         "pipeline_total_s": round(tpu["total_s"], 2),
         "pipeline_init_s": round(tpu["init_s"], 2),
         "pipeline_polish_s": round(tpu["polish_s"], 2),
+        # init-phase attribution (parse_s, align_s, bp_decode_s,
+        # build_windows_s, pipeline_overlap_saved_s) so BENCH rounds can
+        # pin future init regressions to a phase
+        "pipeline_init_breakdown": tpu["timings"],
         "pipeline_mbp_per_sec": round(tput, 4),
+        **fused_metrics,
         "pipeline_cpu_mbp": cpu_mbp,
         "pipeline_cpu_total_s": round(cpu["total_s"], 2),
         "pipeline_cpu_mbp_per_sec": round(cput, 4),

@@ -7,7 +7,13 @@
 set -e
 cd "$(dirname "$0")/../.."
 python -m pytest tests/test_ops_swar.py -q
-python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py
+# columnar host-init shard (fail-fast, same pattern as the SWAR shard):
+# vectorized-vs-legacy window/layer parity, the native breaking-points
+# decoder, and the pipelined run() — including the num_threads=1
+# sequential-fallback smoke — before anything slow runs
+python -m pytest tests/test_columnar_init.py tests/test_window.py -q
+python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
+  --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py
 DATA=/root/reference/test/data
 python -m racon_tpu -t 8 \
   "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \

@@ -124,8 +124,10 @@ def main(argv=None) -> int:
         else:
             trace = contextlib.nullcontext()
         with trace:
-            polisher.initialize()
-            polished = polisher.polish(not args.include_unpolished)
+            # fused surface: window build and consensus pipelined through
+            # a bounded queue (sequential fallback at -t 1) — output is
+            # byte-identical to initialize() + polish()
+            polished = polisher.run(not args.include_unpolished)
     except (ValueError, RuntimeError, OSError) as e:
         print(f"[racon::] error: {e}", file=sys.stderr)
         return 1

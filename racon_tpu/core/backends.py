@@ -49,6 +49,12 @@ class PythonPoaConsensus:
     """Spoa-semantics POA over windows in pure Python (sequential; the
     oracle the native engine is validated against)."""
 
+    # pipelined-polish chunk sizing (Polisher.run): the host engines have
+    # no fixed device-group geometry, so prefer large streamed ranges —
+    # fewer run() calls keep the native thread pool saturated and bound
+    # the GIL traffic between the layer producer and the packer
+    group_pairs_hint = 1 << 18
+
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1):
         self.engine = PoaAlignmentEngine(match, mismatch, gap)
@@ -68,6 +74,8 @@ class NativePoaConsensus:
     ``src/polisher.cpp:490-503`` with per-thread spoa engines). Produces
     byte-identical consensuses to :class:`PythonPoaConsensus`; windows the
     native engine flags as failed are re-polished by the Python engine."""
+
+    group_pairs_hint = 1 << 18  # see PythonPoaConsensus
 
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1):

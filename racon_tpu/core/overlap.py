@@ -13,13 +13,60 @@ Behavioural spec from the reference's ``src/overlap.cpp``:
 
 The CIGAR walk here is run-based (O(runs + window boundaries)) rather than the
 reference's per-base loop, with identical emitted pairs.
+
+Breaking points are carried **columnar**: ``Overlap.breaking_points`` is an
+int32 ndarray of shape (k, 4) — one row ``(t_first, q_first, t_end_excl,
+q_end_excl)`` per window region — or ``None`` before derivation. The device
+aligner emits these rows batched straight off its per-boundary tables, the
+host decode batches whole CIGAR sets through the native extension
+(``native.bp_from_cigar_batch``), and the polisher's window build consumes
+the concatenated rows vectorized; the tuple-pair form only survives as the
+test oracle (:func:`breaking_points_from_cigar` /
+:meth:`Overlap.breaking_point_pairs`).
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..utils.cigar import parse_cigar
+
+
+def bp_pairs_to_array(pairs: List[Tuple[int, int]]) -> "np.ndarray":
+    """Fold the walker's flat (t, q) pair list (two entries per window
+    region) into the columnar (k, 4) int32 row form."""
+    arr = np.asarray(pairs, dtype=np.int32).reshape(-1, 4)
+    return arr
+
+
+def bp_array_to_pairs(arr) -> List[Tuple[int, int]]:
+    """Back-convert columnar rows to the legacy flat pair list (tests and
+    oracle comparisons)."""
+    if arr is None or len(arr) == 0:
+        return []
+    flat = np.asarray(arr, dtype=np.int64).reshape(-1, 2)
+    return [tuple(r) for r in flat.tolist()]
+
+
+def decode_breaking_points_batch(cigars, q_offs, t_begins, t_ends,
+                                 window_length: int,
+                                 num_threads: int = 1) -> List["np.ndarray"]:
+    """CIGAR -> columnar breaking-point rows for a whole overlap batch.
+
+    Prefers the native thread-pool decoder (GIL-free, one flat output
+    allocation — ``native/bp.cpp``); falls back to the Python run-based
+    walker when no C++ toolchain is available. Both emit row-identical
+    arrays."""
+    from .. import native
+
+    if native.available():
+        return native.bp_from_cigar_batch(cigars, q_offs, t_begins, t_ends,
+                                          window_length, num_threads)
+    return [bp_pairs_to_array(breaking_points_from_cigar(
+                cig, qo, tb, te, window_length))
+            for cig, qo, tb, te in zip(cigars, q_offs, t_begins, t_ends)]
 
 
 class Overlap:
@@ -43,7 +90,9 @@ class Overlap:
         self.cigar: Optional[str] = None
         self.is_valid = True
         self.is_transmuted = False
-        self.breaking_points: List[Tuple[int, int]] = []
+        # columnar (k, 4) int32 rows of (t_first, q_first, t_end_excl,
+        # q_end_excl), or None before derivation
+        self.breaking_points: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------ ctors
 
@@ -205,7 +254,7 @@ class Overlap:
         (reference: edlib NW at ``overlap.cpp:205-224``)."""
         if not self.is_transmuted:
             raise RuntimeError("overlap is not transmuted")
-        if self.breaking_points:
+        if self.breaking_points is not None:
             return
         if not self.cigar:
             if aligner is None:
@@ -217,8 +266,12 @@ class Overlap:
 
     def find_breaking_points_from_cigar(self, window_length: int) -> None:
         q_off = self.q_length - self.q_end if self.strand else self.q_begin
-        self.breaking_points.extend(breaking_points_from_cigar(
+        self.breaking_points = bp_pairs_to_array(breaking_points_from_cigar(
             self.cigar, q_off, self.t_begin, self.t_end, window_length))
+
+    def breaking_point_pairs(self) -> List[Tuple[int, int]]:
+        """Legacy flat (t, q) pair view of the columnar rows (tests)."""
+        return bp_array_to_pairs(self.breaking_points)
 
 
 def breaking_points_from_cigar(cigar: str, q_off: int, t_begin: int,

@@ -14,19 +14,35 @@ Behavioural spec from the reference's ``src/polisher.cpp``:
 - ``polish()`` (``polisher.cpp:485-547``): per-window consensus via the
   backend, stitch per target, emit ``LN:i/RC:i/XC:f`` tags.
 
+Host init is **columnar** (round 7): breaking points travel as flat int32
+row arrays end-to-end (device tables -> ``Overlap.breaking_points`` ->
+one concatenated (P, 4) matrix), the min-span and mean-PHRED layer filters
+and all window arithmetic run vectorized over that matrix (quality means
+via per-read prefix sums), and layers group into windows through a single
+stable argsort — the per-overlap/per-pair Python loops the r5 bench showed
+dominating wall-clock are gone. ``run()`` additionally pipelines
+initialize -> polish: the layer assembly streams completed window ranges
+through a bounded queue into the consensus engine, while the background
+consensus warm-up compile overlaps the device alignment (reference
+analog: the CUDA polisher overlaps its aligner batches with host work
+and streams windows into the polisher, ``cudapolisher.cpp:86-228``).
+
 Memory contract (reference analog: 1 GiB parse chunks,
 ``polisher.cpp:26,227-263``): the parsers stream records line-by-line
 (never the whole file), overlaps release their CIGAR the moment breaking
-points are derived (``overlap.py: find_breaking_points``) and their
-breaking points once window layers are assigned; the device aligner sees
-the overlap stream in bounded 64k-pair slices, so transient span copies
-stay O(slice). Like the reference, the full sequence set stays resident
-(windows hold views into it); the wrapper's ``--split`` bounds that too.
+points are derived and their breaking-point rows once window layers are
+assembled; the device aligner sees the overlap stream in bounded 64k-pair
+slices, so transient span copies stay O(slice). Like the reference, the
+full sequence set stays resident (windows hold views into it); the
+wrapper's ``--split`` bounds that too.
 """
 
 from __future__ import annotations
 
 import enum
+import sys
+import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -34,7 +50,7 @@ import numpy as np
 from ..io import parsers
 from ..utils.logger import Logger
 from .backends import make_aligner, make_consensus
-from .overlap import Overlap
+from .overlap import Overlap, decode_breaking_points_batch
 from .sequence import Sequence
 from .window import Window, WindowType
 
@@ -109,16 +125,37 @@ class Polisher:
         self.targets_coverages: List[int] = []
         self._window_type = WindowType.TGS
         self._dummy_quality = b"!" * window_length
+        self._id_to_first_window: Optional[np.ndarray] = None
+        self._window_lengths: Optional[np.ndarray] = None
+        self._backbone_s = 0.0
+        # init-phase wall-clock breakdown (parse_s, align_s, bp_decode_s,
+        # build_windows_s, pipeline_overlap_saved_s) — bench.py records it
+        self.timings: Dict[str, float] = {}
 
     # ---------------------------------------------------------- initialize
 
     def initialize(self) -> None:
+        """Load, filter, align and window the inputs (synchronous surface;
+        :meth:`run` pipelines the same phases against polish)."""
         if self.windows:
+            # warning on stderr: stdout carries the polished FASTA
             print("[racon_tpu::Polisher::initialize] warning: "
-                  "object already initialized!")
+                  "object already initialized!", file=sys.stderr)
             return
+        overlaps = self._initialize_core()
+        self.logger.log()
+        self._assemble_layers(overlaps)
+        self.logger.log("[racon_tpu::Polisher::initialize] "
+                        "transformed data into windows")
+
+    def _initialize_core(self) -> List[Overlap]:
+        """Every initialize phase up to (and including) breaking points:
+        parse, filter, transmute, overlap alignment + columnar decode,
+        then the backbone-window build. Returns the filtered overlap set,
+        ready for layer assembly."""
         log = self.logger
         log.log()
+        t_parse = time.perf_counter()
 
         tparse = parsers.sequence_parser_for(self.target_path)
         self.sequences = [Sequence(r.name, r.data, r.quality)
@@ -177,7 +214,7 @@ class Polisher:
         log.log()
 
         oparse = parsers.overlap_parser_for(self.overlaps_path)
-        overlaps: List[Optional[Overlap]] = []
+        overlaps: List[Overlap] = []
         for rec in oparse(self.overlaps_path):
             o = Overlap.from_record(rec)
             o.transmute(self.sequences, name_to_id, id_to_id)
@@ -222,7 +259,8 @@ class Polisher:
         # transmute-parallelism (reference P3: one future per sequence,
         # ``polisher.cpp:368-377``): revcomp materialization is a numpy
         # LUT-take + flip (``sequence.py``), which releases the GIL on
-        # real read lengths, so a thread pool parallelizes it
+        # real read lengths, so a thread pool parallelizes it (chunked —
+        # per-item futures cost more than most transmutes)
         if self.num_threads > 1 and len(self.sequences) > 64:
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(self.num_threads) as pool:
@@ -230,16 +268,25 @@ class Polisher:
                     lambda iv: iv[1].transmute(has_name[iv[0]],
                                                has_data[iv[0]],
                                                has_reverse[iv[0]]),
-                    enumerate(self.sequences)))
+                    enumerate(self.sequences), chunksize=64))
         else:
             for i, seq in enumerate(self.sequences):
                 seq.transmute(has_name[i], has_data[i], has_reverse[i])
 
-        self.find_overlap_breaking_points(overlaps)
-        log.log()
+        self.timings["parse_s"] = round(time.perf_counter() - t_parse, 3)
 
-        self._build_windows(overlaps)
-        log.log("[racon_tpu::Polisher::initialize] transformed data into windows")
+        self.find_overlap_breaking_points(overlaps)
+
+        # backbone windows build AFTER alignment: a failed alignment then
+        # leaves self.windows empty, so the double-init guard stays
+        # accurate and the polisher is cleanly re-initializable
+        t_bb = time.perf_counter()
+        self._build_backbone_windows()
+        self._backbone_s = time.perf_counter() - t_bb
+        # meaningful only for run(): layer-assembly wall hidden under the
+        # consensus engine (the split surface overlaps nothing)
+        self.timings.setdefault("pipeline_overlap_saved_s", 0.0)
+        return overlaps
 
     def _filter_overlaps(self, overlaps: List[Overlap]) -> List[Overlap]:
         """Per-query group filter (``polisher.cpp:283-307``): drop
@@ -268,20 +315,23 @@ class Polisher:
         """Align CIGAR-less overlaps (batched through the aligner backend —
         reference: ``polisher.cpp:461-483`` / ``cudapolisher.cpp:86-200``)
         then derive per-window breaking points, advancing the reference's
-        20-bin progress bar (``polisher.cpp:475-481``)."""
+        20-bin progress bar (``polisher.cpp:475-481``). Host-side CIGARs
+        (SAM input, host aligner output) decode to columnar rows in one
+        native thread-pool batch instead of per-overlap Python walks."""
         log = self.logger
+        t_align = time.perf_counter()
         msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
-        need = [o for o in overlaps if not o.cigar and not o.breaking_points]
-        handled = set()  # resolved end-to-end on device (maybe-empty bps)
+        need = [o for o in overlaps
+                if not o.cigar and o.breaking_points is None]
         if getattr(self.aligner, "wants_full_stream", False):
             # device backend buckets/chunks internally; hand it a large
             # slice so batches stay dense, but still bound the transient
             # span copies (2x aligned bases of duplicated host bytes if
             # unbounded — reference analog: 1 GiB streaming chunks,
             # polisher.cpp:26). Breaking points come straight off the
-            # device (~8 bytes per window boundary) instead of CIGARs
-            # (~2 bits per base) — the host link's bandwidth, not the DP,
-            # bounded the aligner.
+            # device as columnar rows (~8 bytes per window boundary)
+            # instead of CIGARs (~2 bits per base) — the host link's
+            # bandwidth, not the DP, bounded the aligner.
             chunk = 65536
             for begin in range(0, len(need), chunk):
                 part = need[begin:begin + chunk]
@@ -297,7 +347,6 @@ class Polisher:
                                                      len(need)))
                 for o, bp in zip(part, bps):
                     o.breaking_points = bp
-                    handled.add(id(o))
         else:
             # host path: bounded chunks keep transient span copies O(chunk)
             # rather than O(total reads) (reference analog: 1 GiB streaming
@@ -311,27 +360,199 @@ class Polisher:
                 for o, cigar in zip(part, cigars):
                     o.cigar = cigar
                 log.bar_to(msg, begin + len(part), len(need))
-        for o in overlaps:
-            if id(o) not in handled:
-                o.find_breaking_points(self.sequences, self.window_length)
+        self.timings["align_s"] = round(time.perf_counter() - t_align, 3)
+
+        t_decode = time.perf_counter()
+        todo = [o for o in overlaps if o.breaking_points is None]
+        if todo:
+            arrs = decode_breaking_points_batch(
+                [o.cigar or "" for o in todo],
+                [o.q_length - o.q_end if o.strand else o.q_begin
+                 for o in todo],
+                [o.t_begin for o in todo], [o.t_end for o in todo],
+                self.window_length, self.num_threads)
+            for o, arr in zip(todo, arrs):
+                o.breaking_points = arr
+                o.cigar = None
+        self.timings["bp_decode_s"] = round(
+            time.perf_counter() - t_decode, 3)
         self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
 
-    def _build_windows(self, overlaps: List[Overlap]) -> None:
+    # ------------------------------------------------------- window build
+
+    def _build_backbone_windows(self) -> None:
+        """Slice every target into backbone windows (layer 0). Records the
+        per-target first-window offsets and per-window backbone lengths
+        the vectorized layer assembly indexes into."""
         window_length = self.window_length
-        id_to_first_window = [0] * (self.targets_size + 1)
+        id_to_first = np.zeros(self.targets_size + 1, dtype=np.int64)
+        win_lens: List[int] = []
         for i in range(self.targets_size):
             target = self.sequences[i]
             data = target.data
+            quality = target.quality
             k = 0
             for j in range(0, len(data), window_length):
                 length = min(j + window_length, len(data)) - j
-                quality = (self._dummy_quality[:length]
-                           if target.quality is None
-                           else target.quality[j:j + length])
+                q = (self._dummy_quality[:length] if quality is None
+                     else quality[j:j + length])
                 self.windows.append(Window(i, k, self._window_type,
-                                           data[j:j + length], quality))
+                                           data[j:j + length], q))
+                win_lens.append(length)
                 k += 1
-            id_to_first_window[i + 1] = id_to_first_window[i] + k
+            id_to_first[i + 1] = id_to_first[i] + k
+        self._id_to_first_window = id_to_first
+        self._window_lengths = np.asarray(win_lens, dtype=np.int64)
+
+    def _assemble_layers(self, overlaps: List[Overlap], emit=None,
+                         chunk_windows: int = 0) -> None:
+        """Columnar layer assembly: one concatenated (P, 4) breaking-point
+        matrix, vectorized min-span/mean-PHRED filters and window
+        arithmetic, a single stable argsort grouping layers by window, and
+        a tight slice-and-append loop over only the surviving rows.
+
+        ``emit(first_window, end_window)`` (optional) is called after
+        every ``chunk_windows``-sized window range has all its layers —
+        the :meth:`run` producer streams those ranges into the consensus
+        queue. Emission walks window ranks in order, so a range is
+        complete exactly when the sorted pair sweep passes it."""
+        t_build = time.perf_counter()
+        if self._id_to_first_window is None:
+            self._build_backbone_windows()
+        window_length = self.window_length
+        n_ov = len(overlaps)
+        n_win = len(self.windows)
+        t_ids = np.fromiter((o.t_id for o in overlaps), np.int64, n_ov)
+        self.targets_coverages = np.bincount(
+            t_ids, minlength=self.targets_size).tolist()
+
+        counts = np.fromiter(
+            (0 if o.breaking_points is None else len(o.breaking_points)
+             for o in overlaps), np.int64, n_ov)
+        total_pairs = int(counts.sum())
+        if total_pairs == 0:
+            if emit is not None:
+                emit(0, n_win)
+            self.timings["build_windows_s"] = round(
+                self._backbone_s + (time.perf_counter() - t_build), 3)
+            return
+        bp = np.concatenate(
+            [o.breaking_points for o in overlaps
+             if o.breaking_points is not None
+             and len(o.breaking_points)]).astype(np.int64)
+        pair_ov = np.repeat(np.arange(n_ov), counts)
+        t_first, q_first = bp[:, 0], bp[:, 1]
+        t_endx, q_endx = bp[:, 2], bp[:, 3]
+        span = q_endx - q_first
+
+        # min-span filter: same float compare as the legacy per-pair loop
+        keep = ~(span < 0.02 * window_length)
+
+        # mean-PHRED filter via per-read quality prefix sums: integer
+        # sums are exact in float64, so sums/span - 33.0 reproduces the
+        # legacy  qual[b:e].mean() - 33.0  bit-for-bit. Overlaps process
+        # in bounded slices whose quality bytes concatenate into ONE
+        # prefix-sum array each (a cumsum per overlap costs more in call
+        # overhead than the sums themselves).
+        offs = np.zeros(n_ov + 1, dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        qthr = self.quality_threshold
+        data_refs: List[bytes] = []
+        qual_refs: List[Optional[bytes]] = []
+        for o in overlaps:
+            seq = self.sequences[o.q_id]
+            if o.strand:
+                data_refs.append(seq.reverse_complement)
+                qual_refs.append(seq.reverse_quality)
+            else:
+                data_refs.append(seq.data)
+                qual_refs.append(seq.quality)
+        budget = 8 << 20  # quality bytes per slice (bounds the transient)
+        i = 0
+        while i < n_ov:
+            j, total = i, 0
+            while j < n_ov and (j == i or total < budget):
+                if qual_refs[j] is not None:
+                    total += len(qual_refs[j])
+                j += 1
+            if total:
+                base = np.full(j - i, -1, dtype=np.int64)
+                parts = []
+                pos = 0
+                for k in range(i, j):
+                    qual = qual_refs[k]
+                    if qual is None:
+                        continue
+                    base[k - i] = pos
+                    parts.append(np.frombuffer(qual, dtype=np.uint8))
+                    pos += len(qual)
+                csum = np.zeros(pos + 1, dtype=np.int64)
+                np.cumsum(np.concatenate(parts), dtype=np.int64,
+                          out=csum[1:])
+                pair_base = np.repeat(base, counts[i:j])
+                sel = np.flatnonzero(pair_base >= 0) + int(offs[i])
+                shift = pair_base[pair_base >= 0]
+                sums = (csum[q_endx[sel] + shift]
+                        - csum[q_first[sel] + shift])
+                keep[sel] &= (sums / span[sel] - 33.0) >= qthr
+            i = j
+
+        rank = t_first // window_length
+        win_id = self._id_to_first_window[t_ids[pair_ov]] + rank
+        layer_begin = t_first - rank * window_length
+        layer_end = t_endx - rank * window_length - 1
+        # add_layer's begin == end silent skip, vectorized
+        keep &= layer_begin != layer_end
+
+        kept = np.flatnonzero(keep)
+        if kept.size:
+            backbone_len = self._window_lengths[win_id[kept]]
+            if ((layer_begin[kept] > layer_end[kept])
+                    | (layer_end[kept] > backbone_len)).any():
+                raise ValueError("layer begin and end positions are invalid")
+
+        # window-major grouping: stable, so layers keep the overlap-stream
+        # order inside each window (the POA's tie-break contract)
+        order = kept[np.argsort(win_id[kept], kind="stable")]
+        sorted_win = win_id[order]
+        ov_l = pair_ov[order].tolist()
+        qb_l = q_first[order].tolist()
+        qe_l = q_endx[order].tolist()
+        wi_l = sorted_win.tolist()
+        b_l = layer_begin[order].tolist()
+        e_l = layer_end[order].tolist()
+
+        windows = self.windows
+        if not chunk_windows:
+            chunk_windows = n_win
+        for w0 in range(0, n_win, chunk_windows):
+            w1 = min(w0 + chunk_windows, n_win)
+            p0, p1 = (int(x) for x in np.searchsorted(sorted_win, [w0, w1]))
+            for wi, ov, qb, qe, lb, le in zip(
+                    wi_l[p0:p1], ov_l[p0:p1], qb_l[p0:p1], qe_l[p0:p1],
+                    b_l[p0:p1], e_l[p0:p1]):
+                win = windows[wi]
+                qual = qual_refs[ov]
+                win.sequences.append(data_refs[ov][qb:qe])
+                win.qualities.append(qual[qb:qe]
+                                     if qual is not None else None)
+                win.positions.append((lb, le))
+            if emit is not None:
+                emit(w0, w1)
+
+        for o in overlaps:
+            o.breaking_points = None
+        self.timings["build_windows_s"] = round(
+            self._backbone_s + (time.perf_counter() - t_build), 3)
+
+    def _build_windows_legacy(self, overlaps: List[Overlap]) -> None:
+        """The pre-columnar per-overlap/per-pair build, kept verbatim (on
+        the row representation) as the parity oracle for
+        ``tests/test_columnar_init.py``. Not called by the pipeline."""
+        window_length = self.window_length
+        if self._id_to_first_window is None:
+            self._build_backbone_windows()
+        id_to_first_window = self._id_to_first_window
 
         self.targets_coverages = [0] * self.targets_size
 
@@ -344,25 +565,26 @@ class Polisher:
             qual_all = seq.reverse_quality if o.strand else seq.quality
             qual_arr = (np.frombuffer(qual_all, dtype=np.uint8)
                         if qual_all else None)
-            for j in range(0, len(bp), 2):
-                q_begin, q_end = bp[j][1], bp[j + 1][1]
+            for row in (bp if bp is not None else ()):
+                t_begin, q_begin = int(row[0]), int(row[1])
+                t_end, q_end = int(row[2]), int(row[3])
                 if q_end - q_begin < min_span:
                     continue
                 if qual_arr is not None:
                     avg = float(qual_arr[q_begin:q_end].mean()) - 33.0
                     if avg < self.quality_threshold:
                         continue
-                window_rank = bp[j][0] // window_length
-                window_id = id_to_first_window[o.t_id] + window_rank
+                window_rank = t_begin // window_length
+                window_id = int(id_to_first_window[o.t_id]) + window_rank
                 window_start = window_rank * window_length
                 data = data_all[q_begin:q_end]
                 quality = (qual_all[q_begin:q_end]
                            if qual_all is not None else None)
                 self.windows[window_id].add_layer(
                     data, quality,
-                    bp[j][0] - window_start,
-                    bp[j + 1][0] - window_start - 1)
-            o.breaking_points = []
+                    t_begin - window_start,
+                    t_end - window_start - 1)
+            o.breaking_points = None
 
     # -------------------------------------------------------------- polish
 
@@ -374,7 +596,107 @@ class Polisher:
         polished_flags = self.consensus.run(
             self.windows, self.trim,
             progress=lambda d, t: log.bar_to(msg, d, t))
+        return self._stitch(polished_flags, drop_unpolished_sequences)
 
+    def run(self, drop_unpolished_sequences: bool = True) -> List[Sequence]:
+        """Fused initialize + polish with the two phases pipelined: the
+        columnar layer assembly streams completed window ranges through a
+        bounded queue into the consensus engine, so polishing starts on
+        fully-layered windows while later windows are still being built
+        (on top of the intra-init overlaps ``_initialize_core`` already
+        runs). ``num_threads == 1`` — and an already-initialized polisher
+        — take the sequential initialize()/polish() path; output is
+        byte-identical either way (per-window consensus is independent of
+        batch composition)."""
+        if self.windows:
+            return self.polish(drop_unpolished_sequences)
+        if self.num_threads <= 1:
+            self.initialize()
+            return self.polish(drop_unpolished_sequences)
+
+        from queue import Queue
+
+        overlaps = self._initialize_core()
+        log = self.logger
+        log.log()
+
+        n_win = len(self.windows)
+        # granularity: about one consensus device group's worth of layer
+        # pairs per range (group_pairs_hint — keeps the engine's fused
+        # executions full-size), never below 1024 windows
+        rows = sum(0 if o.breaking_points is None
+                   else len(o.breaking_points) for o in overlaps)
+        depth = max(1.0, rows / max(1, n_win))
+        chunk_windows = max(
+            1024, int(getattr(self.consensus, "group_pairs_hint", 32768)
+                      / depth))
+        ranges: "Queue" = Queue(maxsize=4)  # bounded in-flight depth
+        failure: List[BaseException] = []
+
+        def produce():
+            try:
+                t_cpu = time.thread_time()
+                self._assemble_layers(
+                    overlaps, emit=lambda a, b: ranges.put((a, b)),
+                    chunk_windows=chunk_windows)
+                # re-record with the producer's CPU time: its wall-clock
+                # stretches under GIL sharing with the consensus engine,
+                # which would overstate both the build cost and the
+                # overlap saving derived from it
+                self.timings["build_windows_s"] = round(
+                    self._backbone_s + time.thread_time() - t_cpu, 3)
+            except BaseException as e:  # surfaced on the consumer side
+                failure.append(e)
+            finally:
+                ranges.put(None)
+
+        producer = threading.Thread(target=produce, name="racon-layers",
+                                    daemon=True)
+        producer.start()
+
+        msg = "[racon_tpu::Polisher::polish] generating consensus"
+        flags: List[bool] = [False] * n_win
+        queue_wait = 0.0
+        try:
+            while True:
+                t_get = time.perf_counter()
+                item = ranges.get()
+                queue_wait += time.perf_counter() - t_get
+                if item is None:
+                    break
+                a, b = item
+                if b > a:
+                    flags[a:b] = self.consensus.run(self.windows[a:b],
+                                                    self.trim)
+                log.bar_to(msg, b, n_win)
+        except BaseException:
+            # a consensus fault mid-stream must not strand the producer
+            # on the bounded queue: drain to its sentinel and retire it
+            # before propagating, else the daemon thread pins the whole
+            # overlap/window state and keeps appending layers under any
+            # later polish on this object
+            while ranges.get() is not None:
+                pass
+            producer.join()
+            raise
+        producer.join()
+        if failure:
+            raise failure[0]
+        # init->polish overlap actually realized: layer-assembly wall
+        # that hid under the consensus engine instead of preceding it
+        self.timings["pipeline_overlap_saved_s"] = round(
+            max(0.0, self.timings.get("build_windows_s", 0.0)
+                - queue_wait), 3)
+        # the layer assembly finished no later than its last consumed
+        # range; the log lands here so the two threads never interleave
+        # writes inside the progress bar
+        log.log("[racon_tpu::Polisher::initialize] "
+                "transformed data into windows")
+        return self._stitch(flags, drop_unpolished_sequences)
+
+    def _stitch(self, polished_flags: List[bool],
+                drop_unpolished_sequences: bool) -> List[Sequence]:
+        log = self.logger
         dst: List[Sequence] = []
         polished_data: List[bytes] = []
         num_polished = 0

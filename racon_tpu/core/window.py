@@ -46,8 +46,9 @@ class Window:
             return
         if quality is not None and len(sequence) != len(quality):
             raise ValueError("unequal quality size")
-        backbone_len = len(self.sequences[0])
-        if begin >= end or begin > backbone_len or end > backbone_len:
+        # single bounds guard: begin == end already returned above, and
+        # begin > backbone_len is unreachable once begin < end <= len
+        if begin > end or end > len(self.sequences[0]):
             raise ValueError("layer begin and end positions are invalid")
         self.sequences.append(sequence)
         self.qualities.append(quality)

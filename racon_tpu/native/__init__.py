@@ -143,6 +143,13 @@ def load():
         ctypes.c_char_p, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_void_p), ctypes.c_char_p]
+    lib.rt_bp_from_cigar_batch.restype = None
+    lib.rt_bp_from_cigar_batch.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64)]
     _lib = lib
     return _lib
 
@@ -256,6 +263,48 @@ def nw_cigar_batch(pairs, num_threads: int = 1) -> list:
         result.append(ctypes.string_at(outs[i]).decode())
         lib.rt_free(outs[i])
     return result
+
+
+def bp_from_cigar_batch(cigars, q_offs, t_begins, t_ends,
+                        window_length: int, num_threads: int = 1) -> list:
+    """Decode many CIGARs into per-window breaking-point rows
+    (t_first, q_first, t_end_excl, q_end_excl) on the C++ thread pool.
+    Returns one int32 ndarray of shape (k, 4) per CIGAR, row-identical to
+    the Python walker ``core.overlap.breaking_points_from_cigar``. The
+    per-overlap arrays are views into one flat columnar buffer, so the
+    whole batch costs a single allocation."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        raise NativeBuildError("native library unavailable")
+    count = len(cigars)
+    if count == 0:
+        return []
+    enc = [c.encode() if isinstance(c, str) else (c or b"")
+           for c in cigars]
+    c_cigars = (ctypes.c_char_p * count)(*enc)
+    qo = np.ascontiguousarray(q_offs, dtype=np.int64)
+    tb = np.ascontiguousarray(t_begins, dtype=np.int64)
+    te = np.ascontiguousarray(t_ends, dtype=np.int64)
+    w = int(window_length)
+    # capacity per overlap = its window-boundary count (multiples of w in
+    # (t_begin, t_end), plus the final t_end-1 boundary)
+    caps = np.maximum(0, (np.maximum(te, 1) - 1) // w - tb // w) + 1
+    offs = np.zeros(count + 1, dtype=np.int64)
+    np.cumsum(caps, out=offs[1:])
+    out = np.empty(int(offs[-1]) * 4, dtype=np.int32)
+    counts = np.zeros(count, dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.rt_bp_from_cigar_batch(
+        count, c_cigars,
+        qo.ctypes.data_as(i64p), tb.ctypes.data_as(i64p),
+        te.ctypes.data_as(i64p), w, num_threads,
+        offs.ctypes.data_as(i64p),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        counts.ctypes.data_as(i64p))
+    return [out[int(offs[i]) * 4: (int(offs[i]) + int(counts[i])) * 4]
+            .reshape(-1, 4) for i in range(count)]
 
 
 def parse_seqfile(path: str, is_fastq: bool):

@@ -535,8 +535,10 @@ class TpuAligner(PallasDispatchMixin):
         overlap's ``(t_begin, q_off)`` (global target start; strand-aware
         global query offset). The walk stays on device and only ~8 bytes
         per window boundary are fetched (:func:`_breaking_points_kernel`);
-        rejects fall back to the host aligner + the shared CIGAR walker,
-        and every path returns pairs identical to the walker's."""
+        rejects fall back to the host aligner + the shared CIGAR walker.
+        Returns one **columnar** int32 ndarray of shape (k, 4) per pair —
+        rows of (t_first, q_first, t_end_excl, q_end_excl), row-identical
+        to the walker's pairs on every path."""
         return self._drive(pairs, progress, (window_length, metas))
 
     def _drive(self, pairs, progress, bp_meta):
@@ -544,7 +546,8 @@ class TpuAligner(PallasDispatchMixin):
         # pairs re-enter a wider bucket and are only counted once, on
         # their last visit; fallback/empty pairs are counted when resolved
         done_pairs = 0
-        cigars: List = [("" if bp_meta is None else [])
+        empty_bp = np.zeros((0, 4), dtype=np.int32)
+        cigars: List = [("" if bp_meta is None else empty_bp)
                         for _ in range(len(pairs))]
         by_bucket = {}
         reject: List[int] = []
@@ -554,7 +557,7 @@ class TpuAligner(PallasDispatchMixin):
                     cigars[idx] = (f"{len(t)}D" if len(t) else
                                    (f"{len(q)}I" if len(q) else ""))
                 else:
-                    cigars[idx] = []  # no matches -> no breaking points
+                    cigars[idx] = empty_bp  # no matches -> no breaking pts
                 done_pairs += 1
                 continue
             bi = self._bucket_index(len(q), len(t))
@@ -659,13 +662,14 @@ class TpuAligner(PallasDispatchMixin):
                 for i, cig in zip(reject, fb):
                     cigars[i] = cig
             else:
-                from ..core.overlap import breaking_points_from_cigar
+                from ..core.overlap import decode_breaking_points_batch
                 w, metas = bp_meta
-                for i, cig in zip(reject, fb):
-                    t_begin, q_off = metas[i]
-                    cigars[i] = breaking_points_from_cigar(
-                        cig, q_off, t_begin,
-                        t_begin + len(pairs[i][1]), w)
+                arrs = decode_breaking_points_batch(
+                    fb, [metas[i][1] for i in reject],
+                    [metas[i][0] for i in reject],
+                    [metas[i][0] + len(pairs[i][1]) for i in reject], w)
+                for i, arr in zip(reject, arrs):
+                    cigars[i] = arr
         if progress is not None and done_pairs < len(pairs):
             progress(len(pairs), len(pairs))
         return cigars
@@ -863,33 +867,42 @@ class TpuAligner(PallasDispatchMixin):
         return self._launch_chunk(pairs, chunk, max_len, band, bp_meta)
 
     def _finish_chunk_bp(self, launched, band, results, reject, bp_meta):
-        """Breaking-points decode: the per-boundary tables are already on
-        host-friendly shapes; convert to the walker's absolute-coordinate
-        pair list (same accept/reject gate as the CIGAR path — the walk is
-        complete and provably optimal inside the band, else escalate)."""
+        """Breaking-points decode: convert the fetched per-boundary tables
+        to columnar (k, 4) int32 row arrays for the WHOLE chunk in one
+        vectorized pass (same accept/reject gate as the CIGAR path — the
+        walk is complete and provably optimal inside the band, else
+        escalate). The per-pair arrays are views into one flat buffer."""
         chunk, pairs, n, m, out, _geom = launched
         from ..parallel import fetch_global
         w, metas = bp_meta
         bp_first, bp_last, score, fi, fj = fetch_global(list(out))
         BIG = 1 << 30
+        C = len(chunk)
+        n_h = np.asarray(n[:C], dtype=np.int64)
+        m_h = np.asarray(m[:C], dtype=np.int64)
+        diff = np.abs(n_h - m_h)
+        accept = ((np.asarray(score[:C], dtype=np.int64)
+                   <= band // 2 - diff - 2)
+                  & (np.asarray(fi[:C]) == 0) & (np.asarray(fj[:C]) == 0))
+        tb = np.fromiter((metas[idx][0] for idx in chunk), np.int64, C)
+        qo = np.fromiter((metas[idx][1] for idx in chunk), np.int64, C)
+        te = tb + np.fromiter((len(pairs[idx][1]) for idx in chunk),
+                              np.int64, C)
+        n_reg = (te - 1) // w - tb // w
+        fp = np.asarray(bp_first[:C], dtype=np.int64)
+        lp = np.asarray(bp_last[:C], dtype=np.int64)
+        col = np.arange(fp.shape[1], dtype=np.int64)
+        valid = (col[None, :] <= n_reg[:, None]) & (fp < BIG) \
+            & accept[:, None]
+        rows = np.stack(
+            [tb[:, None] + (fp >> 14), qo[:, None] + (fp & 0x3FFF),
+             tb[:, None] + (lp >> 14) + 1, qo[:, None] + (lp & 0x3FFF) + 1],
+            axis=-1)
+        flat = rows[valid].astype(np.int32)
+        parts = np.split(flat, np.cumsum(valid.sum(axis=1))[:-1])
         for k, idx in enumerate(chunk):
-            diff = abs(int(n[k]) - int(m[k]))
-            clean = int(fi[k]) == 0 and int(fj[k]) == 0
-            if not (int(score[k]) <= band // 2 - diff - 2 and clean):
+            if accept[k]:
+                results[idx] = parts[k]
+                self.stats["device"] += 1
+            else:
                 reject.append(idx)
-                continue
-            t_begin, q_off = metas[idx]
-            bp: List[Tuple[int, int]] = []
-            fp_row, lp_row = bp_first[k], bp_last[k]
-            t_end = t_begin + len(pairs[idx][1])
-            n_reg = (t_end - 1) // w - t_begin // w
-            for b in range(n_reg + 1):
-                fp = int(fp_row[b])
-                if fp >= BIG:
-                    continue
-                lp = int(lp_row[b])
-                bp.append((t_begin + (fp >> 14), q_off + (fp & 0x3FFF)))
-                bp.append((t_begin + (lp >> 14) + 1,
-                           q_off + (lp & 0x3FFF) + 1))
-            results[idx] = bp
-            self.stats["device"] += 1

@@ -775,6 +775,11 @@ class TpuPoaConsensus(PallasDispatchMixin):
     ``src/cuda/cudapolisher.cpp:72-83``).
     """
 
+    # pipelined-polish chunk sizing hint (Polisher.run): window ranges
+    # streamed into run() should carry about one device group's worth of
+    # layer pairs, so the pipelining never shrinks the fused executions
+    group_pairs_hint = MAX_GROUP_PAIRS
+
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
                  max_depth: int = 200, band: int = BAND, rounds: int = 6,
                  mesh=None, ins_theta: float = 0.25, del_beta: float = 0.65,

@@ -1,0 +1,362 @@
+"""Columnar host-init parity and the pipelined run() surface.
+
+The vectorized window/layer build (``Polisher._assemble_layers``: one
+concatenated breaking-point matrix, vectorized span/PHRED filters,
+argsort-by-window grouping) must produce windows IDENTICAL to the legacy
+per-overlap/per-pair loop (kept as ``_build_windows_legacy``) — same
+layer bytes, same qualities, same positions, same per-window layer order —
+across strands, dummy-quality (FASTA) reads and fragment-correction-style
+multi-overlap-per-query inputs. The fused ``run()`` must emit the same
+polished sequences as initialize() + polish(), pipelined or via the
+``num_threads=1`` sequential fallback.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from racon_tpu.core.overlap import (Overlap, bp_pairs_to_array,
+                                    breaking_points_from_cigar)
+from racon_tpu.core.polisher import Polisher, PolisherType
+from racon_tpu.core.sequence import Sequence
+from racon_tpu.core.window import WindowType
+from racon_tpu.utils.cigar import parse_cigar
+
+
+def make_polisher(window_length=100, quality_threshold=10.0,
+                  type_=PolisherType.C, num_threads=1):
+    # paths are never touched: sequences/overlaps are injected directly
+    return Polisher("x.fasta", "x.paf", "x.fasta", type_, window_length,
+                    quality_threshold, 0.3, True, 3, -5, -4, num_threads)
+
+
+def random_cigar(rng, approx_len):
+    ops = []
+    total_t = 0
+    while total_t < approx_len:
+        op = rng.choices(["M", "I", "D"], weights=[8, 1, 1])[0]
+        n = rng.randint(1, 25)
+        ops.append(f"{n}{op}")
+        if op in ("M", "D"):
+            total_t += n
+    return "".join(ops), total_t
+
+
+def random_state(seed, window_length, with_quality=True, multi=False):
+    """Targets + reads + overlaps whose breaking points come from real
+    CIGAR walks (so every row satisfies the walker's invariants).
+    ``multi`` makes several overlaps share a query read (the
+    fragment-correction/ava shape)."""
+    rng = random.Random(seed)
+    nrng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+
+    targets = [Sequence(b"t%d" % i,
+                        bases[nrng.integers(0, 4, rng.randint(
+                            window_length * 2, window_length * 7))]
+                        .tobytes())
+               for i in range(3)]
+    sequences = list(targets)
+    overlaps = []
+    n_reads = 12 if multi else 30
+    for ri in range(n_reads):
+        per_read = rng.randint(2, 3) if multi else 1
+        read_len = rng.randint(window_length * 2, window_length * 5)
+        data = bases[nrng.integers(0, 4, read_len)].tobytes()
+        qual = (bytes(nrng.integers(33, 64, read_len).astype(np.uint8))
+                if with_quality and ri % 3 else None)
+        sequences.append(Sequence(b"r%d" % ri, data, qual))
+        q_id = len(sequences) - 1
+        for _ in range(per_read):
+            t_id = rng.randrange(len(targets))
+            t_len = len(targets[t_id].data)
+            for _retry in range(20):
+                cigar, t_span = random_cigar(rng, rng.randint(
+                    window_length // 2,
+                    min(t_len - 1, read_len - 30, window_length * 4)))
+                q_span = sum(n for n, op in parse_cigar(cigar)
+                             if op in ("M", "I"))
+                if t_span < t_len and q_span <= read_len - 10:
+                    break
+            else:
+                continue
+            t_begin = rng.randint(0, t_len - t_span - 1)
+            q_begin = rng.randint(0, read_len - q_span)
+            o = Overlap()
+            o.q_id = q_id
+            o.t_id = t_id
+            o.strand = rng.random() < 0.5
+            o.q_begin, o.q_end = q_begin, q_begin + q_span
+            o.q_length = read_len
+            o.t_begin, o.t_end = t_begin, t_begin + t_span
+            o.is_transmuted = True
+            q_off = o.q_length - o.q_end if o.strand else o.q_begin
+            o.breaking_points = bp_pairs_to_array(
+                breaking_points_from_cigar(cigar, q_off, o.t_begin,
+                                           o.t_end, window_length))
+            overlaps.append(o)
+    return sequences, len(targets), overlaps
+
+
+def clone_overlaps(overlaps):
+    out = []
+    for o in overlaps:
+        c = Overlap()
+        c.q_id, c.t_id, c.strand = o.q_id, o.t_id, o.strand
+        c.q_begin, c.q_end, c.q_length = o.q_begin, o.q_end, o.q_length
+        c.t_begin, c.t_end = o.t_begin, o.t_end
+        c.is_transmuted = True
+        c.breaking_points = o.breaking_points.copy()
+        out.append(c)
+    return out
+
+
+def build_with(p, sequences, n_targets, overlaps, legacy, **assemble_kw):
+    p.sequences = list(sequences)
+    p.targets_size = n_targets
+    p._window_type = WindowType.TGS
+    if legacy:
+        p._build_backbone_windows()
+        p._build_windows_legacy(overlaps)
+    else:
+        p._assemble_layers(overlaps, **assemble_kw)
+    return p
+
+
+def assert_windows_identical(pa, pb):
+    assert len(pa.windows) == len(pb.windows)
+    assert pa.targets_coverages == pb.targets_coverages
+    for wa, wb in zip(pa.windows, pb.windows):
+        assert (wa.id, wa.rank, wa.type) == (wb.id, wb.rank, wb.type)
+        assert wa.sequences == wb.sequences
+        assert wa.qualities == wb.qualities
+        assert wa.positions == wb.positions
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_columnar_matches_legacy(seed):
+    wl = [50, 100, 500][seed % 3]
+    qthr = [10.0, 12.5][seed % 2]
+    sequences, nt, overlaps = random_state(seed, wl)
+    pa = build_with(make_polisher(wl, qthr), sequences, nt,
+                    clone_overlaps(overlaps), legacy=False)
+    pb = build_with(make_polisher(wl, qthr), sequences, nt,
+                    clone_overlaps(overlaps), legacy=True)
+    n_layers = sum(len(w.sequences) - 1 for w in pa.windows)
+    n_rows = sum(len(o.breaking_points) for o in overlaps)
+    assert 0 < n_layers <= n_rows
+    assert_windows_identical(pa, pb)
+
+
+def test_columnar_filters_fire_identically():
+    """Both filters must actually drop rows (min-span and mean-PHRED),
+    and drop the SAME rows in both paths."""
+    sequences, nt, overlaps = random_state(11, 500, with_quality=True)
+    pa = build_with(make_polisher(500, 43.0), sequences, nt,
+                    clone_overlaps(overlaps), legacy=False)
+    pb = build_with(make_polisher(500, 43.0), sequences, nt,
+                    clone_overlaps(overlaps), legacy=True)
+    n_layers = sum(len(w.sequences) - 1 for w in pa.windows)
+    n_rows = sum(len(o.breaking_points) for o in overlaps)
+    # qualities are uniform in [33, 64) (avg ~ 15): a 43.0 threshold
+    # (avg >= 43 means raw mean >= 76) rejects every quality-bearing
+    # read's rows, while the dummy-quality reads (ri % 3 == 0) pass
+    assert 0 < n_layers < n_rows
+    assert_windows_identical(pa, pb)
+
+
+def test_columnar_matches_legacy_fragment_multi_overlap():
+    """Fragment-correction shape: several overlaps per query read (mixed
+    strands), like the PolisherType.F / ava-overlap inputs."""
+    sequences, nt, overlaps = random_state(99, 100, multi=True)
+    assert len({o.q_id for o in overlaps}) < len(overlaps)  # shared reads
+    pa = build_with(make_polisher(100, type_=PolisherType.F), sequences,
+                    nt, clone_overlaps(overlaps), legacy=False)
+    pb = build_with(make_polisher(100, type_=PolisherType.F), sequences,
+                    nt, clone_overlaps(overlaps), legacy=True)
+    assert_windows_identical(pa, pb)
+
+
+def test_columnar_matches_legacy_dummy_quality():
+    """FASTA reads (quality None): the PHRED filter must not fire and the
+    layers must carry None qualities, both paths."""
+    sequences, nt, overlaps = random_state(7, 100, with_quality=False)
+    assert all(s.quality is None for s in sequences)
+    pa = build_with(make_polisher(100), sequences, nt,
+                    clone_overlaps(overlaps), legacy=False)
+    pb = build_with(make_polisher(100), sequences, nt,
+                    clone_overlaps(overlaps), legacy=True)
+    n_layers = sum(len(w.sequences) - 1 for w in pa.windows)
+    assert n_layers > 0
+    assert all(q is None for w in pa.windows for q in w.qualities[1:])
+    assert_windows_identical(pa, pb)
+
+
+def test_columnar_chunked_emit_matches_monolithic():
+    """The run() producer's chunked emission (small chunk_windows, emit
+    callback) must build the same windows as one monolithic pass, and the
+    emitted ranges must tile [0, n_windows) in order."""
+    sequences, nt, overlaps = random_state(3, 50)
+    pa = build_with(make_polisher(50), sequences, nt,
+                    clone_overlaps(overlaps), legacy=False)
+    emitted = []
+    pb = build_with(make_polisher(50), sequences, nt,
+                    clone_overlaps(overlaps), legacy=False,
+                    emit=lambda a, b: emitted.append((a, b)),
+                    chunk_windows=3)
+    assert_windows_identical(pa, pb)
+    assert emitted[0][0] == 0 and emitted[-1][1] == len(pb.windows)
+    assert all(e0[1] == e1[0] for e0, e1 in zip(emitted, emitted[1:]))
+    assert len(emitted) > 1
+
+
+def test_columnar_releases_breaking_points():
+    sequences, nt, overlaps = random_state(5, 100)
+    overlaps = clone_overlaps(overlaps)
+    build_with(make_polisher(100), sequences, nt, overlaps, legacy=False)
+    assert all(o.breaking_points is None for o in overlaps)
+
+
+# ---------------------------------------------------------------- run()
+
+def write_synthetic_assembly(tmp_path, seed=23, n_contigs=2, contig=3000):
+    """Two-contig ~5x forward+reverse synthetic assembly on disk (the
+    test_pipeline multi-target shape, plus reverse-strand reads)."""
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    comp = bytes.maketrans(b"ACGT", b"TGCA")
+
+    def mutate(seq, rate):
+        out = seq.copy()
+        flips = rng.random(len(out)) < rate
+        out[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        return out
+
+    truths = [bases[rng.integers(0, 4, contig)] for _ in range(n_contigs)]
+    backbones = [mutate(t, 0.06) for t in truths]
+    layout = tmp_path / "layout.fasta"
+    with open(layout, "wb") as f:
+        for ti, bb in enumerate(backbones):
+            f.write(b">ctg%d\n" % ti + bb.tobytes() + b"\n")
+    reads_path = tmp_path / "reads.fastq"
+    paf_path = tmp_path / "ovl.paf"
+    with open(reads_path, "wb") as rf, open(paf_path, "wb") as pf:
+        ri = 0
+        for ti, truth in enumerate(truths):
+            for start in range(0, contig - 600, 150):
+                end = min(start + 900, contig)
+                read = mutate(truth[start:end], 0.08)
+                name = b"read%d" % ri
+                strand = b"-" if ri % 3 == 0 else b"+"
+                if strand == b"-":
+                    read_bytes = read.tobytes().translate(comp)[::-1]
+                else:
+                    read_bytes = read.tobytes()
+                rf.write(b"@" + name + b"\n" + read_bytes +
+                         b"\n+\n" + b"9" * len(read) + b"\n")
+                pf.write(b"\t".join([
+                    name, b"%d" % len(read), b"0", b"%d" % len(read),
+                    strand, b"ctg%d" % ti, b"%d" % contig, b"%d" % start,
+                    b"%d" % end, b"%d" % (len(read) // 2),
+                    b"%d" % len(read), b"255"]) + b"\n")
+                ri += 1
+    return reads_path, paf_path, layout
+
+
+def polished_bytes(seqs):
+    return [(s.name, s.data) for s in seqs]
+
+
+def test_run_matches_initialize_polish(tmp_path):
+    """Fused pipelined run() output == initialize() + polish() output
+    (same bytes, names and order), with the pipelined path actually
+    chunking (num_threads > 1)."""
+    from racon_tpu.core.polisher import create_polisher
+
+    rp, pp, lp = write_synthetic_assembly(tmp_path)
+    ref = create_polisher(str(rp), str(pp), str(lp), num_threads=4)
+    ref.initialize()
+    want = polished_bytes(ref.polish(True))
+
+    fused = create_polisher(str(rp), str(pp), str(lp), num_threads=4)
+    got = polished_bytes(fused.run(True))
+    assert got == want
+    assert "build_windows_s" in fused.timings
+    assert "align_s" in fused.timings
+    assert "bp_decode_s" in fused.timings
+
+
+def test_run_sequential_fallback_num_threads_1(tmp_path):
+    """num_threads=1 takes the sequential initialize()/polish() path and
+    must produce the same bytes as the pipelined run."""
+    from racon_tpu.core.polisher import create_polisher
+
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=31)
+    seq = create_polisher(str(rp), str(pp), str(lp), num_threads=1)
+    got1 = polished_bytes(seq.run(True))
+
+    par = create_polisher(str(rp), str(pp), str(lp), num_threads=4)
+    got4 = polished_bytes(par.run(True))
+    assert got1 == got4
+    assert len(got1) == 2
+
+
+def test_failed_initialize_leaves_object_reinitializable(tmp_path):
+    """An alignment fault mid-init must leave self.windows empty so the
+    double-init guard stays accurate and a retry rebuilds everything."""
+    from racon_tpu.core.polisher import create_polisher
+
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=13, n_contigs=1,
+                                          contig=1500)
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=2)
+    real_align = p.aligner.align_batch
+    calls = {"n": 0}
+
+    def flaky(pairs, *a, **kw):
+        if calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("injected aligner fault")
+        return real_align(pairs, *a, **kw)
+
+    p.aligner.align_batch = flaky
+    with pytest.raises(RuntimeError, match="injected"):
+        p.initialize()
+    assert p.windows == []  # clean: retry is a real re-init, not a no-op
+    p.initialize()
+    assert len(p.windows) > 0
+    assert len(p.polish(True)) == 1
+
+
+def test_run_consensus_fault_retires_producer(tmp_path):
+    """A consensus fault mid-stream must drain the bounded queue and join
+    the producer before propagating (no stranded daemon thread)."""
+    import threading
+
+    from racon_tpu.core.polisher import create_polisher
+
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=17)
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=4)
+    p.consensus.run = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("injected consensus fault"))
+    before = {t.name for t in threading.enumerate()}
+    with pytest.raises(RuntimeError, match="injected"):
+        p.run(True)
+    leaked = [t for t in threading.enumerate()
+              if t.name == "racon-layers" and t.is_alive()]
+    assert not leaked, (before, leaked)
+
+
+def test_double_initialize_warns_on_stderr(tmp_path, capsys):
+    """The double-init warning must go to stderr: stdout carries the
+    polished FASTA byte stream."""
+    from racon_tpu.core.polisher import create_polisher
+
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=5, n_contigs=1,
+                                          contig=1500)
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=2)
+    p.initialize()
+    p.initialize()  # second call: warning, no rebuild
+    cap = capsys.readouterr()
+    assert "already initialized" in cap.err
+    assert cap.out == ""

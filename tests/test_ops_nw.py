@@ -107,6 +107,7 @@ def test_breaking_points_match_cigar_walker():
     w = 64
 
     from racon_tpu.core.backends import PythonAligner
+    from racon_tpu.core.overlap import bp_array_to_pairs
     al = TpuAligner(buckets=((256, 128),), fallback=PythonAligner())
     bps = al.breaking_points_batch(pairs, metas, w)
     assert al.stats["fallback_length"] > 0  # deletion pairs exercise the
@@ -114,4 +115,5 @@ def test_breaking_points_match_cigar_walker():
     for k, ((q, t), (t_begin, q_off)) in enumerate(zip(pairs, metas)):
         oracle = breaking_points_from_cigar(
             cigars[k], q_off, t_begin, t_begin + len(t), w)
-        assert bps[k] == oracle, f"pair {k}"
+        assert bps[k].dtype == np.int32 and bps[k].shape[1] == 4
+        assert bp_array_to_pairs(bps[k]) == oracle, f"pair {k}"

@@ -95,7 +95,46 @@ def test_breaking_points_match_perbase_walker(seed):
 
     expected = perbase_breaking_points(
         cigar, strand, q_begin, q_end, q_length, t_begin, t_end, window_length)
-    assert o.breaking_points == expected
+    assert o.breaking_point_pairs() == expected
+    # columnar invariants: (k, 4) int32 rows, one per window region
+    assert o.breaking_points.dtype.name == "int32"
+    assert o.breaking_points.shape == (len(expected) // 2, 4)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_bp_decode_matches_python_walker(seed):
+    """The native thread-pool CIGAR decoder (native/bp.cpp) must emit
+    rows identical to the Python run-based walker for whole batches,
+    including empty CIGARs and unknown ops."""
+    import numpy as np
+
+    from racon_tpu import native
+    from racon_tpu.core.overlap import (breaking_points_from_cigar,
+                                        bp_pairs_to_array,
+                                        decode_breaking_points_batch)
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(100 + seed)
+    window_length = rng.choice([25, 100, 500])
+    cigars, qos, tbs, tes = [], [], [], []
+    for _ in range(64):
+        cigar, t_span = random_cigar(rng, rng.randint(40, 1500))
+        tb = rng.randint(0, 700)
+        cigars.append(cigar)
+        qos.append(rng.randint(0, 300))
+        tbs.append(tb)
+        tes.append(tb + t_span)
+    cigars.append("")  # degenerate: no runs -> no rows
+    qos.append(1)
+    tbs.append(5)
+    tes.append(5)
+    arrs = decode_breaking_points_batch(cigars, qos, tbs, tes,
+                                        window_length, num_threads=4)
+    for cig, qo, tb, te, arr in zip(cigars, qos, tbs, tes, arrs):
+        oracle = bp_pairs_to_array(breaking_points_from_cigar(
+            cig, qo, tb, te, window_length))
+        assert np.array_equal(arr, oracle)
 
 
 def test_paf_ctor_error():

@@ -1,0 +1,69 @@
+"""Window.add_layer boundary semantics (single collapsed guard).
+
+The reference validates layer bounds in ``window.cpp:42-63``; our
+``add_layer`` used to test ``begin >= end`` twice (a ``begin == end``
+early-return made the later ``>=`` check half-dead). The collapsed guard
+must keep the exact legacy semantics: empty/zero-span layers skip
+silently (even with out-of-range positions), inverted or overflowing
+bounds raise, and the inclusive ``end == backbone_len`` boundary is
+accepted."""
+
+import pytest
+
+from racon_tpu.core.window import Window, WindowType
+
+
+def make_window(backbone=b"ACGTACGTAC"):
+    return Window(0, 0, WindowType.TGS, backbone, b"!" * len(backbone))
+
+
+def test_add_layer_appends_valid_layer():
+    w = make_window()
+    w.add_layer(b"ACGT", b"9999", 2, 6)
+    assert w.sequences[-1] == b"ACGT"
+    assert w.qualities[-1] == b"9999"
+    assert w.positions[-1] == (2, 6)
+
+
+def test_add_layer_end_at_backbone_len_accepted():
+    w = make_window()
+    w.add_layer(b"ACG", None, 7, 10)  # end == len(backbone): inclusive cap
+    assert w.positions[-1] == (7, 10)
+
+
+def test_add_layer_zero_span_skips_silently():
+    w = make_window()
+    w.add_layer(b"ACGT", None, 5, 5)
+    assert len(w.sequences) == 1  # backbone only
+
+
+def test_add_layer_zero_span_skips_even_out_of_range():
+    # legacy contract: the begin == end early-return fires before any
+    # bounds validation, so an out-of-range zero-span layer skips quietly
+    w = make_window()
+    w.add_layer(b"ACGT", None, 99, 99)
+    assert len(w.sequences) == 1
+
+
+def test_add_layer_empty_sequence_skips_silently():
+    w = make_window()
+    w.add_layer(b"", None, 12, 3)  # invalid bounds, but empty skips first
+    assert len(w.sequences) == 1
+
+
+def test_add_layer_inverted_bounds_raise():
+    w = make_window()
+    with pytest.raises(ValueError, match="begin and end"):
+        w.add_layer(b"ACGT", None, 6, 2)
+
+
+def test_add_layer_end_past_backbone_raises():
+    w = make_window()
+    with pytest.raises(ValueError, match="begin and end"):
+        w.add_layer(b"ACGT", None, 2, 11)
+
+
+def test_add_layer_quality_length_mismatch_raises():
+    w = make_window()
+    with pytest.raises(ValueError, match="quality"):
+        w.add_layer(b"ACGT", b"99", 2, 6)
