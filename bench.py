@@ -244,9 +244,9 @@ def bench_scale():
     synthetic window set (mixed lengths/depths, rejects firing — see
     :func:`build_stress_windows`), with a measured CPU-engine baseline
     on the same windows for an apples-to-apples ``scale_vs_cpu``."""
-    import os
+    from racon_tpu import flags as racon_flags
 
-    mbp = float(os.environ.get("RACON_TPU_BENCH_SCALE", "1") or 0)
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_SCALE")
     if not mbp:
         return {}
     from racon_tpu.core.backends import CpuPoaConsensus
@@ -343,7 +343,9 @@ def bench_pipeline():
     import tempfile
     import time as _time
 
-    mbp = float(os.environ.get("RACON_TPU_BENCH_PIPELINE", "10") or 0)
+    from racon_tpu import flags as racon_flags
+
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_PIPELINE")
     if not mbp:
         return {}
     sys.path.insert(0, os.path.join(os.path.dirname(
@@ -409,7 +411,7 @@ def bench_pipeline():
     # through run() — init->polish pipelined; polished bytes must be
     # IDENTICAL to the split surface (scale-sized bit-parity check)
     fused_metrics = {}
-    if os.environ.get("RACON_TPU_BENCH_FUSED", "1") != "0":
+    if racon_flags.get_bool("RACON_TPU_BENCH_FUSED"):
         log(f"pipeline bench: {mbp} Mbp TPU fused (pipelined) run...")
         fused = run_once(mbp, seed=23, backend="tpu", batches=4,
                          fused=True)

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# ASan/UBSan smoke over the native host core (graftlint's native half):
+# RACON_TPU_NATIVE_SANITIZE=1 rebuilds racon_tpu/native/*.cpp with
+# -fsanitize=address,undefined into its own cached .so, then a python
+# subprocess — with the ASan runtime preloaded, since CPython itself is
+# not ASan-built — exercises the two threaded/streaming paths with the
+# ugliest memory behaviour: the bp.cpp thread-pool breaking-points
+# decoder and the chunked-inflate gzip sequence parser. Any heap
+# overflow / UB the sanitizers see aborts the process (UBSan runs with
+# -fno-sanitize-recover), failing this check. Skips cleanly when the
+# toolchain has no ASan runtime.
+set -e
+cd "$(dirname "$0")/../.."
+
+# `|| true`: without g++ the substitution fails under set -e; the
+# empty result then takes the SKIP branch like the rest of the repo's
+# no-toolchain fallbacks
+LIBASAN="$(g++ -print-file-name=libasan.so 2>/dev/null || true)"
+if [ -z "$LIBASAN" ] || [ ! -e "$LIBASAN" ]; then
+    echo "native sanitize: SKIP (no libasan runtime)"
+    exit 0
+fi
+
+# leak detection needs ptrace; CPython also "leaks" interned objects at
+# exit by design — this smoke is after overflows/UB, not exit leaks
+export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
+export RACON_TPU_NATIVE_SANITIZE=1
+
+LD_PRELOAD="$LIBASAN" python - <<'PY'
+import pathlib
+import sys
+
+from racon_tpu import native
+
+path = native.build(force=True)
+assert path.name == "libracon_native_san.so", path
+assert native.available(), "sanitized native library failed to load"
+
+# 1) bp.cpp: the thread-pool breaking-points decoder (threaded writes
+#    into one shared columnar output buffer at per-overlap offsets)
+cigars = ["5M2I3M1D10M", "20M", "", "3M1I1D3M" * 40, "7M"] * 50
+n = len(cigars)
+arrs = native.bp_from_cigar_batch(
+    cigars, [0] * n, [0] * n,
+    [sum(int(c[:-1]) for c in __import__("re").findall(r"\d+[MD]", s))
+     for s in cigars],
+    5, num_threads=4)
+assert len(arrs) == n and arrs[0].shape[1] == 4
+print("bp thread-pool decoder under ASan/UBSan: ok", file=sys.stderr)
+
+# 2) parsers.cpp: the streaming chunked-inflate gzip path (bounded
+#    rolling buffer refills across chunk boundaries)
+import gzip
+import tempfile
+
+with tempfile.NamedTemporaryFile(suffix=".fastq.gz", delete=False) as f:
+    tmp = f.name
+    long_seq = b"ACGT" * 50000  # forces multi-chunk inflate + long lines
+    with gzip.open(f, "wb") as gz:
+        for i in range(20):
+            gz.write(b"@r%d\n" % i + long_seq + b"\n+\n"
+                     + b"9" * len(long_seq) + b"\n")
+recs = native.parse_seqfile(tmp, True)
+assert len(recs) == 20 and recs[0][1] == long_seq
+pathlib.Path(tmp).unlink()
+print("streaming gzip parser under ASan/UBSan: ok", file=sys.stderr)
+PY
+
+echo "native sanitize: OK"

@@ -14,4 +14,12 @@ if bad:
     print("lines over 100 columns:", *bad[:20], sep="\n  ")
     sys.exit(1)
 PY
+# ruff baseline (pyproject [tool.ruff]); advisory-skip when the tool is
+# not in the image — graftlint (the tools/analysis shard) is the hard
+# correctness gate either way
+if command -v ruff >/dev/null 2>&1; then
+    ruff check racon_tpu tools tests bench.py
+else
+    echo "style: ruff not installed, baseline skipped"
+fi
 echo "style: OK"

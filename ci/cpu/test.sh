@@ -6,7 +6,22 @@
 # byte-diff.
 set -e
 cd "$(dirname "$0")/../.."
+# graftlint shard first (fail-fast, cheapest): the linter's own
+# fixture-based self-tests, then the repo-wide run — zero unsuppressed
+# findings is a hard gate (tracer leaks, unguarded SWAR entry points,
+# swallowed exceptions, rogue env flags, host syncs in hot loops)
+python -m tools.analysis --selftest
+python -m tools.analysis --quiet racon_tpu tests tools bench.py
+# the README env-flags table is generated from racon_tpu/flags.py and
+# must not drift
+python -m racon_tpu.flags --check-readme README.md
 python -m pytest tests/test_ops_swar.py -q
+# runtime-sanitizer shard: the SWAR parity suite re-runs with shadow
+# execution + canaries armed (every chunk sampled), plus the seeded
+# fault/stall tests proving both sanitizer halves fire
+RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
+  python -m pytest tests/test_ops_swar.py tests/test_sanitize.py \
+  tests/test_graftlint.py -q
 # columnar host-init shard (fail-fast, same pattern as the SWAR shard):
 # vectorized-vs-legacy window/layer parity, the native breaking-points
 # decoder, and the pipelined run() — including the num_threads=1
@@ -14,6 +29,9 @@ python -m pytest tests/test_ops_swar.py -q
 python -m pytest tests/test_columnar_init.py tests/test_window.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py
+# native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
+# parser); self-skips when the toolchain lacks the ASan runtime
+bash ci/checks/native_sanitize.sh
 DATA=/root/reference/test/data
 python -m racon_tpu -t 8 \
   "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import flags, sanitize
 from ..io import parsers
 from ..utils.logger import Logger
 from .backends import make_aligner, make_consensus
@@ -241,10 +242,8 @@ class Polisher:
         # for tiny inputs (the compile would outlive the whole run) and
         # via RACON_TPU_WARMUP=0; a wrong shape estimate only wastes a
         # background compile (see TpuPoaConsensus.warmup_async).
-        import os as _os
         warm = getattr(self.consensus, "warmup_async", None)
-        if warm is not None and _os.environ.get("RACON_TPU_WARMUP",
-                                                "1") != "0":
+        if warm is not None and flags.get_bool("RACON_TPU_WARMUP"):
             est_pairs = sum(o.length // self.window_length + 1
                             for o in overlaps)
             targets_bases = sum(len(self.sequences[i].data)
@@ -323,6 +322,38 @@ class Polisher:
         msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
         need = [o for o in overlaps
                 if not o.cigar and o.breaking_points is None]
+        # sanitizer: the overlap-alignment phase compiles one kernel set
+        # per (bucket, batch) shape — a per-chunk recompile is a
+        # regression this budget catches (no-op unless RACON_TPU_SANITIZE).
+        # Scoped to the aligner kernel modules so the background
+        # consensus warm-up thread's compiles are not charged here.
+        with sanitize.PhaseRetraceBudget(
+                "align", prefixes=("racon_tpu.ops.nw",
+                                   "racon_tpu.ops.pallas_nw",
+                                   "racon_tpu.parallel")):
+            self._align_need(need, log, msg)
+        self.timings["align_s"] = round(time.perf_counter() - t_align, 3)
+
+        t_decode = time.perf_counter()
+        todo = [o for o in overlaps if o.breaking_points is None]
+        if todo:
+            arrs = decode_breaking_points_batch(
+                [o.cigar or "" for o in todo],
+                [o.q_length - o.q_end if o.strand else o.q_begin
+                 for o in todo],
+                [o.t_begin for o in todo], [o.t_end for o in todo],
+                self.window_length, self.num_threads)
+            for o, arr in zip(todo, arrs):
+                o.breaking_points = arr
+                o.cigar = None
+        self.timings["bp_decode_s"] = round(
+            time.perf_counter() - t_decode, 3)
+        self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
+
+    def _align_need(self, need, log, msg) -> None:
+        """The backend-dispatch half of breaking-point alignment (split
+        out so the sanitizer's retrace budget wraps exactly the phase
+        that launches kernels)."""
         if getattr(self.aligner, "wants_full_stream", False):
             # device backend buckets/chunks internally; hand it a large
             # slice so batches stay dense, but still bound the transient
@@ -360,23 +391,6 @@ class Polisher:
                 for o, cigar in zip(part, cigars):
                     o.cigar = cigar
                 log.bar_to(msg, begin + len(part), len(need))
-        self.timings["align_s"] = round(time.perf_counter() - t_align, 3)
-
-        t_decode = time.perf_counter()
-        todo = [o for o in overlaps if o.breaking_points is None]
-        if todo:
-            arrs = decode_breaking_points_batch(
-                [o.cigar or "" for o in todo],
-                [o.q_length - o.q_end if o.strand else o.q_begin
-                 for o in todo],
-                [o.t_begin for o in todo], [o.t_end for o in todo],
-                self.window_length, self.num_threads)
-            for o, arr in zip(todo, arrs):
-                o.breaking_points = arr
-                o.cigar = None
-        self.timings["bp_decode_s"] = round(
-            time.perf_counter() - t_decode, 3)
-        self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
 
     # ------------------------------------------------------- window build
 
@@ -593,9 +607,13 @@ class Polisher:
         log.log()
 
         msg = "[racon_tpu::Polisher::polish] generating consensus"
-        polished_flags = self.consensus.run(
-            self.windows, self.trim,
-            progress=lambda d, t: log.bar_to(msg, d, t))
+        with sanitize.PhaseRetraceBudget(
+                "consensus", prefixes=("racon_tpu.ops.poa",
+                                       "racon_tpu.ops.pallas_nw",
+                                       "racon_tpu.parallel")):
+            polished_flags = self.consensus.run(
+                self.windows, self.trim,
+                progress=lambda d, t: log.bar_to(msg, d, t))
         return self._stitch(polished_flags, drop_unpolished_sequences)
 
     def run(self, drop_unpolished_sequences: bool = True) -> List[Sequence]:
@@ -632,12 +650,21 @@ class Polisher:
                       / depth))
         ranges: "Queue" = Queue(maxsize=4)  # bounded in-flight depth
         failure: List[BaseException] = []
+        # sanitizer: stall monitor over the bounded queue — a deadlocked
+        # producer/consumer pair dumps all thread stacks instead of
+        # hanging silently (None unless RACON_TPU_SANITIZE=1)
+        watchdog = sanitize.queue_watchdog("init->polish queue")
+
+        def emit_range(a, b):
+            if watchdog is not None:
+                watchdog.beat()
+            ranges.put((a, b))
 
         def produce():
             try:
                 t_cpu = time.thread_time()
                 self._assemble_layers(
-                    overlaps, emit=lambda a, b: ranges.put((a, b)),
+                    overlaps, emit=emit_range,
                     chunk_windows=chunk_windows)
                 # re-record with the producer's CPU time: its wall-clock
                 # stretches under GIL sharing with the consensus engine,
@@ -645,6 +672,7 @@ class Polisher:
                 # overlap saving derived from it
                 self.timings["build_windows_s"] = round(
                     self._backbone_s + time.thread_time() - t_cpu, 3)
+            # graftlint: disable=swallowed-exception (re-raised on the consumer thread)
             except BaseException as e:  # surfaced on the consumer side
                 failure.append(e)
             finally:
@@ -655,30 +683,49 @@ class Polisher:
         producer.start()
 
         msg = "[racon_tpu::Polisher::polish] generating consensus"
-        flags: List[bool] = [False] * n_win
+        polished: List[bool] = [False] * n_win
         queue_wait = 0.0
         try:
-            while True:
-                t_get = time.perf_counter()
-                item = ranges.get()
-                queue_wait += time.perf_counter() - t_get
-                if item is None:
-                    break
-                a, b = item
-                if b > a:
-                    flags[a:b] = self.consensus.run(self.windows[a:b],
-                                                    self.trim)
-                log.bar_to(msg, b, n_win)
+            with sanitize.PhaseRetraceBudget(
+                "consensus", prefixes=("racon_tpu.ops.poa",
+                                       "racon_tpu.ops.pallas_nw",
+                                       "racon_tpu.parallel")):
+                while True:
+                    t_get = time.perf_counter()
+                    item = ranges.get()
+                    queue_wait += time.perf_counter() - t_get
+                    if watchdog is not None:
+                        watchdog.beat()
+                    if item is None:
+                        break
+                    a, b = item
+                    if b > a:
+                        polished[a:b] = self.consensus.run(
+                            self.windows[a:b], self.trim)
+                    log.bar_to(msg, b, n_win)
         except BaseException:
             # a consensus fault mid-stream must not strand the producer
-            # on the bounded queue: drain to its sentinel and retire it
+            # on the bounded queue: drain it and retire the thread
             # before propagating, else the daemon thread pins the whole
             # overlap/window state and keeps appending layers under any
-            # later polish on this object
-            while ranges.get() is not None:
-                pass
+            # later polish on this object. The drain is non-blocking:
+            # the fault may fire AFTER the sentinel was consumed (e.g.
+            # the retrace budget raising at the with-block exit), where
+            # a blocking get() would deadlock on the empty queue.
+            from queue import Empty
+            while True:
+                try:
+                    if ranges.get_nowait() is None:
+                        break
+                except Empty:
+                    if not producer.is_alive():
+                        break
+                    time.sleep(0.01)
             producer.join()
             raise
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         producer.join()
         if failure:
             raise failure[0]
@@ -692,7 +739,7 @@ class Polisher:
         # writes inside the progress bar
         log.log("[racon_tpu::Polisher::initialize] "
                 "transformed data into windows")
-        return self._stitch(flags, drop_unpolished_sequences)
+        return self._stitch(polished, drop_unpolished_sequences)
 
     def _stitch(self, polished_flags: List[bool],
                 drop_unpolished_sequences: bool) -> List[Sequence]:

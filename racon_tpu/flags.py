@@ -1,0 +1,201 @@
+"""Central registry of every ``RACON_TPU_*`` environment flag.
+
+This module is the **single sanctioned reader** of ``RACON_TPU_*``
+environment variables: every flag the package (and its tests/benches)
+consults is declared here with a type, default and one-line doc, and all
+call sites go through :func:`raw` / :func:`get_bool` / :func:`get_int` /
+:func:`get_float` / :func:`get_str`.  The ``graftlint`` rule
+``env-flag-registry`` (``tools/analysis``) enforces the monopoly: a
+direct ``os.environ`` read of a ``RACON_TPU_*`` key anywhere else in the
+repo is a lint error, and reading an undeclared name through this module
+raises at runtime.  The README "Environment flags" table is generated
+from this registry (``python -m racon_tpu.flags``), so docs cannot drift
+from the code.
+
+Deliberately dependency-free (no jax, no numpy): ``tests/conftest.py``
+consults flags before the JAX backend may initialize.
+
+Boolean semantics are uniform: unset/empty/``0``/``false``/``no``/``off``
+mean **false**, anything else means **true**.  (This makes
+``RACON_TPU_NO_COMPILE_CACHE=0`` a no-op, where the pre-registry ad-hoc
+read treated any set value as true — the sane reading wins.)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable
+
+_FALSE = ("", "0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class Flag:
+    """One declared environment flag: its default (as the env string the
+    getters parse), a kind tag for the README table, and a one-line doc."""
+
+    name: str
+    default: str
+    kind: str  # "bool" | "int" | "float" | "str" | "path"
+    help: str
+
+
+def _declare(flags: Iterable[Flag]) -> Dict[str, Flag]:
+    reg: Dict[str, Flag] = {}
+    for f in flags:
+        if not f.name.startswith("RACON_TPU_"):
+            raise ValueError(f"flag {f.name!r} outside the RACON_TPU_ "
+                             f"namespace")
+        if not f.help:
+            raise ValueError(f"flag {f.name!r} declared without a doc line")
+        if f.name in reg:
+            raise ValueError(f"flag {f.name!r} declared twice")
+        reg[f.name] = f
+    return reg
+
+
+REGISTRY: Dict[str, Flag] = _declare([
+    # ------------------------------------------------------------- kernels
+    Flag("RACON_TPU_SWAR", "1", "bool",
+         "Packed SWAR kernels (int16x2 score lanes, 2-bit bases); set 0 "
+         "to force the int32 path for A/B measurement."),
+    Flag("RACON_TPU_DYNBOUND", "1", "bool",
+         "Per-block dynamic sweep bounds in the Pallas kernels; set 0 to "
+         "run every block at the static bound for A/B measurement."),
+    Flag("RACON_TPU_WARMUP", "1", "bool",
+         "Background warm-up compilation of the consensus refinement "
+         "loop during Polisher.initialize(); set 0 to disable."),
+    # ------------------------------------------------------- compile cache
+    Flag("RACON_TPU_NO_COMPILE_CACHE", "0", "bool",
+         "Set to disable the persistent XLA compilation cache."),
+    Flag("RACON_TPU_COMPILE_CACHE", "", "path",
+         "Persistent XLA compilation cache directory (default "
+         "~/.cache/racon_tpu_xla)."),
+    # ----------------------------------------------------------- sanitizer
+    Flag("RACON_TPU_SANITIZE", "0", "bool",
+         "Runtime sanitizer: int32 shadow execution of sampled SWAR "
+         "chunks, kernel-output canaries, a jit-retrace budget per "
+         "pipeline phase, and the pipelined-polish queue watchdog."),
+    Flag("RACON_TPU_SANITIZE_SAMPLE", "8", "int",
+         "Shadow-execute every Nth SWAR chunk under the sanitizer "
+         "(1 = every chunk; the first chunk of a run is always checked)."),
+    Flag("RACON_TPU_SANITIZE_WATCHDOG_S", "120", "float",
+         "Pipelined-polish queue watchdog timeout in seconds: with the "
+         "sanitizer on, a producer/consumer stall longer than this dumps "
+         "every thread's stack to stderr."),
+    Flag("RACON_TPU_SANITIZE_RETRACE_BUDGET", "64", "int",
+         "Maximum new jit compilations the sanitizer tolerates per "
+         "pipeline phase before flagging a silent-recompile regression."),
+    Flag("RACON_TPU_NATIVE_SANITIZE", "0", "bool",
+         "Build the native C++ core with ASan/UBSan "
+         "(-fsanitize=address,undefined) into a separate shared object; "
+         "loading it requires the ASan runtime preloaded (see "
+         "ci/checks/native_sanitize.sh)."),
+    # -------------------------------------------------------- tests, bench
+    Flag("RACON_TPU_SLOW", "0", "bool",
+         "Enable the slow (tier-2) test set."),
+    Flag("RACON_TPU_TEST_REAL", "0", "bool",
+         "Run tests on the real accelerator instead of forcing the "
+         "8-virtual-device CPU mesh."),
+    Flag("RACON_TPU_BENCH_SCALE", "1", "float",
+         "bench.py scaling-probe workload size in Mbp (0 disables)."),
+    Flag("RACON_TPU_BENCH_PIPELINE", "10", "float",
+         "bench.py end-to-end pipeline workload size in Mbp "
+         "(0 disables)."),
+    Flag("RACON_TPU_BENCH_FUSED", "1", "bool",
+         "bench.py fused run()-vs-split A/B (and its bit-identity "
+         "assert); set 0 to skip."),
+])
+
+
+def _flag(name: str) -> Flag:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"environment flag {name!r} is not declared in "
+            f"racon_tpu/flags.py — add it to REGISTRY with a doc line "
+            f"(the env-flag-registry lint rule enforces this)") from None
+
+
+def raw(name: str) -> str:
+    """The single sanctioned ``RACON_TPU_*`` environment read: the raw
+    string value of a **declared** flag (registry default when unset)."""
+    f = _flag(name)
+    return os.environ.get(name, f.default)
+
+
+def get_bool(name: str) -> bool:
+    return raw(name).strip().lower() not in _FALSE
+
+
+def get_int(name: str) -> int:
+    """Numeric semantics: unset -> registry default; set-but-empty -> 0
+    (the shell-script way to disable, preserved from the pre-registry
+    ad-hoc reads)."""
+    v = raw(name).strip()
+    return int(v) if v else 0
+
+
+def get_float(name: str) -> float:
+    """See :func:`get_int` for the set-but-empty -> 0 contract."""
+    v = raw(name).strip()
+    return float(v) if v else 0.0
+
+
+def get_str(name: str) -> str:
+    return raw(name)
+
+
+def sanitize_enabled() -> bool:
+    """The runtime-sanitizer master switch (shared shorthand)."""
+    return get_bool("RACON_TPU_SANITIZE")
+
+
+# ------------------------------------------------------- README generation
+
+_TABLE_HEADER = "## Environment flags"
+_TABLE_NOTE = ("<!-- generated by `python -m racon_tpu.flags` from "
+               "racon_tpu/flags.py — do not edit by hand -->")
+
+
+def readme_table() -> str:
+    """The README "Environment flags" section, generated from the
+    registry (one row per flag, declaration order)."""
+    lines = [_TABLE_HEADER, "", _TABLE_NOTE, "",
+             "| Flag | Type | Default | Effect |",
+             "| --- | --- | --- | --- |"]
+    for f in REGISTRY.values():
+        default = f.default if f.default != "" else "(unset)"
+        lines.append(f"| `{f.name}` | {f.kind} | `{default}` | {f.help} |")
+    return "\n".join(lines) + "\n"
+
+
+def check_readme(path: str) -> bool:
+    """True when ``path`` contains the current generated table verbatim
+    (the lint shard runs this so the README cannot drift)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return readme_table() in fh.read()
+    except OSError:
+        return False
+
+
+def _main(argv) -> int:
+    if argv and argv[0] == "--check-readme":
+        if check_readme(argv[1] if len(argv) > 1 else "README.md"):
+            return 0
+        import sys
+        print("README environment-flags table is stale — regenerate with "
+              "`python -m racon_tpu.flags` and paste the output",
+              file=sys.stderr)
+        return 1
+    print(readme_table(), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main(sys.argv[1:]))

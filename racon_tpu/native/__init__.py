@@ -2,18 +2,28 @@
 
 Built on demand with g++ (no pip/pybind11 dependency); the shared object is
 cached next to the sources and rebuilt when any .cpp is newer.
+
+``RACON_TPU_NATIVE_SANITIZE=1`` selects an ASan/UBSan build instead
+(``-fsanitize=address,undefined``, separate cached .so): the CI smoke
+``ci/checks/native_sanitize.sh`` runs the bp.cpp thread-pool decoder and
+the streaming gzip parser under it. Loading the sanitized object needs
+the ASan runtime preloaded (``LD_PRELOAD=$(g++ -print-file-name=
+libasan.so)``), so the variant is chosen per process at first load.
 """
 
 from __future__ import annotations
 
 import ctypes
-import os
 import pathlib
 import subprocess
 import threading
 
+from .. import flags as _flags
+from ..utils.logger import log_swallowed as _log_swallowed
+
 _DIR = pathlib.Path(__file__).resolve().parent
 _LIB_PATH = _DIR / "libracon_native.so"
+_LIB_SAN_PATH = _DIR / "libracon_native_san.so"
 _EXT_PATH = _DIR / "racon_native_ext.so"
 # pyext.cpp is the optional CPython extension (needs Python headers) —
 # built separately so the ctypes core never depends on them
@@ -29,28 +39,48 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+def _sanitize_build() -> bool:
+    """ASan/UBSan build mode (RACON_TPU_NATIVE_SANITIZE=1)."""
+    return _flags.get_bool("RACON_TPU_NATIVE_SANITIZE")
+
+
+def _lib_path() -> pathlib.Path:
+    return _LIB_SAN_PATH if _sanitize_build() else _LIB_PATH
+
+
 def _needs_build() -> bool:
-    if not _LIB_PATH.exists():
+    path = _lib_path()
+    if not path.exists():
         return True
-    lib_mtime = _LIB_PATH.stat().st_mtime
+    lib_mtime = path.stat().st_mtime
     return any(src.stat().st_mtime > lib_mtime for src in _SOURCES)
 
 
 def build(force: bool = False) -> pathlib.Path:
-    """Compile the native library if needed. Returns its path."""
+    """Compile the native library if needed. Returns its path. The
+    sanitized variant keeps frame pointers and -O1 so ASan/UBSan reports
+    carry usable stacks; it caches to its own .so, so the fast build is
+    never evicted by a sanitizer run."""
+    path = _lib_path()
     with _lock:
         if force or _needs_build():
+            if _sanitize_build():
+                opt = ["-O1", "-g", "-fno-omit-frame-pointer",
+                       "-fsanitize=address,undefined",
+                       "-fno-sanitize-recover=undefined"]
+            else:
+                opt = ["-O3", "-march=native"]
             cmd = [
-                "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                "-march=native", "-pthread",
+                "g++", *opt, "-std=c++17", "-shared", "-fPIC",
+                "-pthread",
                 *[str(s) for s in _SOURCES],
-                "-o", str(_LIB_PATH), "-lz",
+                "-o", str(path), "-lz",
             ]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(
                     f"native build failed:\n{proc.stderr[-4000:]}")
-    return _LIB_PATH
+    return path
 
 
 def _load_ext():
@@ -89,14 +119,17 @@ def _load_ext():
                                                    loader)
             _ext = importlib.util.module_from_spec(spec)
             loader.exec_module(_ext)
-        except Exception:
+        except Exception as e:
+            _log_swallowed("native: CPython extension build/load failed "
+                           "(ctypes parser fallback in use)", e)
             _ext = None
     return _ext
 
 
 def load():
     """Load (building if necessary) and return the ctypes library handle,
-    or None when no C++ toolchain is available."""
+    or None when no C++ toolchain is available. The variant (plain vs
+    ASan/UBSan) is fixed at the first successful load of this process."""
     global _lib
     if _lib is not None:
         return _lib
@@ -105,9 +138,26 @@ def load():
             return _lib
     try:
         build()
-    except (NativeBuildError, FileNotFoundError):
+    except (NativeBuildError, FileNotFoundError) as e:
+        _log_swallowed("native: core library unavailable (Python/host "
+                       "fallbacks in use)", e)
         return None
-    lib = ctypes.CDLL(str(_LIB_PATH))
+    try:
+        lib = ctypes.CDLL(str(_lib_path()))
+    except OSError as e:
+        if _sanitize_build():
+            # dlopen of an ASan-instrumented .so into a non-ASan python
+            # fails unless the runtime is preloaded — name the fix
+            # instead of dying with a bare dlopen error (the CI smoke
+            # ci/checks/native_sanitize.sh sets this up)
+            raise NativeBuildError(
+                "loading the RACON_TPU_NATIVE_SANITIZE build requires "
+                "the ASan runtime preloaded: run under LD_PRELOAD="
+                '"$(g++ -print-file-name=libasan.so)" '
+                f"(dlopen said: {e})") from e
+        _log_swallowed("native: core library failed to load "
+                       "(Python/host fallbacks in use)", e)
+        return None
     lib.rt_nw_cigar.restype = ctypes.c_void_p
     lib.rt_nw_cigar.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                 ctypes.c_char_p, ctypes.c_int64]

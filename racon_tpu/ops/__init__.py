@@ -17,16 +17,19 @@ import os as _os
 
 import jax as _jax
 
+from .. import flags as _flags
+from ..utils.logger import log_swallowed as _log_swallowed
+
 # Persist XLA compilations across processes: the kernels are recompiled per
 # (bucket shape x batch size) and a CLI/test run pays tens of seconds of
 # compile time otherwise. Opt out with RACON_TPU_NO_COMPILE_CACHE=1.
-if not _os.environ.get("RACON_TPU_NO_COMPILE_CACHE"):
-    _cache_dir = _os.environ.get(
-        "RACON_TPU_COMPILE_CACHE",
-        _os.path.join(_os.path.expanduser("~"), ".cache", "racon_tpu_xla"))
+if not _flags.get_bool("RACON_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = (_flags.get_str("RACON_TPU_COMPILE_CACHE")
+                  or _os.path.join(_os.path.expanduser("~"), ".cache",
+                                   "racon_tpu_xla"))
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:  # cache is an optimization, never fatal
-        pass
+    except Exception as _e:  # cache is an optimization, never fatal
+        _log_swallowed("ops: persistent XLA compile cache setup", _e)

@@ -476,6 +476,9 @@ class TpuAligner(PallasDispatchMixin):
         # availability probe (swar.swar_ok) — both identical-output, so
         # this knob only exists for A/B measurement and escape hatches.
         self.use_swar = use_swar
+        # sanitizer: per-aligner shadow sampler (first chunk always)
+        from .. import sanitize
+        self._shadow = sanitize.ShadowSampler()
         self.stats = {"device": 0, "fallback_length": 0, "fallback_band": 0,
                       "band_escalated": 0, "swar_chunks": 0,
                       "swar_guard_int32": 0}
@@ -762,6 +765,8 @@ class TpuAligner(PallasDispatchMixin):
                 self.stats["swar_chunks"] += int(sw_p)
                 return chunk, pairs, n, m, out, (max_len, key)
             except Exception as e:
+                from .. import sanitize
+                sanitize.reraise_if_sanitizer(e)
                 self._note_pallas_failure(key, e)
                 # a packed-kernel-only fault must not cost the whole
                 # Pallas path: retry the int32 Mosaic kernel before
@@ -775,6 +780,8 @@ class TpuAligner(PallasDispatchMixin):
                         return chunk, pairs, n, m, out, (max_len,
                                                          base_key)
                     except Exception as e2:
+                        from .. import sanitize
+                        sanitize.reraise_if_sanitizer(e2)
                         self._note_pallas_failure(base_key, e2)
         out = self._dispatch(args, max_len, band, steps, False, sw)
         out = self._attach_bp(out, chunk, pairs, n, m, max_len, bp_meta,
@@ -810,11 +817,31 @@ class TpuAligner(PallasDispatchMixin):
                   use_swar=False):
         if self.mesh is not None:
             from ..parallel import sharded_align
-            return sharded_align(self.mesh, *args, max_len=max_len,
-                                 band=band, steps=steps,
-                                 use_pallas=use_pallas, use_swar=use_swar)
-        return align_chain(*args, max_len=max_len, band=band, steps=steps,
-                           use_pallas=use_pallas, use_swar=use_swar)
+            out = sharded_align(self.mesh, *args, max_len=max_len,
+                                band=band, steps=steps,
+                                use_pallas=use_pallas, use_swar=use_swar)
+        else:
+            out = align_chain(*args, max_len=max_len, band=band,
+                              steps=steps, use_pallas=use_pallas,
+                              use_swar=use_swar)
+        if use_swar:
+            from .. import sanitize
+            if self._shadow.should_shadow():
+                # int32 shadow execution on the SAME walk backend (the
+                # two walks place inactive-gap codes differently, so a
+                # cross-backend compare would flag legitimate deltas):
+                # isolates exactly the packed-lane arithmetic. Both
+                # tuples come down through fetch_global — mesh runs hand
+                # back global sharded arrays np.asarray cannot read.
+                from ..parallel import fetch_global
+                shadow = self._dispatch(args, max_len, band, steps,
+                                        use_pallas, False)
+                sanitize.shadow_compare(
+                    fetch_global(list(out)), fetch_global(list(shadow)),
+                    ("ops_packed", "score", "fi", "fj"),
+                    f"aligner SWAR chunk (max_len={max_len}, "
+                    f"band={band}, steps={steps})")
+        return out
 
     def _finish_chunk(self, launched, band, cigars, reject, bp_meta=None):
         chunk, pairs, n, m, out, (max_len, shape_key) = launched
@@ -824,6 +851,8 @@ class TpuAligner(PallasDispatchMixin):
                 self._finish_chunk_bp(launched, band, cigars, reject,
                                       bp_meta)
             except Exception as e:
+                from .. import sanitize
+                sanitize.reraise_if_sanitizer(e)
                 launched = self._refetch_xla(launched, band, bp_meta, e)
                 self._finish_chunk_bp(launched, band, cigars, reject,
                                       bp_meta)
@@ -831,9 +860,16 @@ class TpuAligner(PallasDispatchMixin):
         try:
             ops_packed, score, fi, fj = fetch_global(list(out))
         except Exception as e:
+            from .. import sanitize
+            sanitize.reraise_if_sanitizer(e)
             launched = self._refetch_xla(launched, band, bp_meta, e)
             chunk, pairs, n, m, out, _ = launched
             ops_packed, score, fi, fj = fetch_global(list(out))
+        from .. import sanitize
+        if sanitize.enabled():
+            sanitize.check_aligner_canaries(
+                score, fi, fj, big=1 << 28,
+                context=f"aligner chunk (band={band})")
         # unpack 4 codes/byte -> [B, 2L] uint8
         shifts = np.array([0, 2, 4, 6], dtype=np.uint8)
         ops = ((ops_packed[:, :, None] >> shifts) & 3).reshape(
@@ -876,6 +912,11 @@ class TpuAligner(PallasDispatchMixin):
         from ..parallel import fetch_global
         w, metas = bp_meta
         bp_first, bp_last, score, fi, fj = fetch_global(list(out))
+        from .. import sanitize
+        if sanitize.enabled():
+            sanitize.check_aligner_canaries(
+                score, fi, fj, big=1 << 28,
+                context=f"aligner bp chunk (band={band})")
         BIG = 1 << 30
         C = len(chunk)
         n_h = np.asarray(n[:C], dtype=np.int64)

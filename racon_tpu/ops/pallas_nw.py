@@ -60,8 +60,8 @@ _LOAD_PAD = 256
 # Per-block dynamic sweep bounds (traced loop trip counts): blocks stop
 # at their longest pair's sweep. Off-switch for A/B measurement — traced
 # trip counts can inhibit Mosaic's static loop optimizations.
-import os as _os
-DYNAMIC_BOUND = _os.environ.get("RACON_TPU_DYNBOUND", "1") != "0"
+from .. import flags as _flags
+DYNAMIC_BOUND = _flags.get_bool("RACON_TPU_DYNBOUND")
 # pair-block (sublane) caps: the TPU grid is sequential, so bigger blocks
 # amortize per-step loop/DMA overhead across more pairs; 64 measured best
 # on v5e for both kernels (32 leaves ~30% on the table, 128 regresses the
@@ -891,7 +891,10 @@ def pallas_ok() -> bool:
                 ok = (np.array_equal(np.asarray(wx), np.asarray(wp))
                       and np.array_equal(np.asarray(ux), np.asarray(up)))
             _PALLAS_OK = ok
-        except Exception:
+        except Exception as e:
+            from ..utils.logger import log_swallowed
+            log_swallowed("pallas: availability probe failed; Mosaic "
+                          "kernels disabled for this process", e)
             _PALLAS_OK = False
     return _PALLAS_OK
 
@@ -934,6 +937,7 @@ def pallas_swar_ok() -> bool:
                 n[k], m[k] = len(q), ln
             args = (jnp.asarray(qrp), jnp.asarray(tp),
                     jnp.asarray(n), jnp.asarray(m))
+            # graftlint: disable=swar-guard (probe bucket: 256 + 2 < BIG16 by construction)
             dp, sp = pallas_nw_fwd(*args, max_len=max_len, band=band,
                                    out_quant=512, use_swar=True)
             dx, sx = _nw_wavefront_kernel(*args, max_len=max_len,
@@ -942,7 +946,10 @@ def pallas_swar_ok() -> bool:
             mx = int((n + m).max())
             _PALLAS_SWAR_OK = (np.array_equal(dp[:, :mx], dx[:, :mx])
                                and np.array_equal(sp, sx))
-        except Exception:
+        except Exception as e:
+            from ..utils.logger import log_swallowed
+            log_swallowed("pallas: SWAR probe failed; packed Mosaic "
+                          "kernel disabled for this process", e)
             _PALLAS_SWAR_OK = False
     return _PALLAS_SWAR_OK
 

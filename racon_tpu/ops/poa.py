@@ -1065,8 +1065,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     K=K_INS, steps=steps, use_pallas=use_pallas,
                     use_swar=sw, Lq2=Lq2, scores=self.scores)
                 jax.block_until_ready(out[10])
-            except Exception:
-                pass  # warm-up is an optimization, never fatal
+            except Exception as e:  # warm-up is an optimization, never fatal
+                from ..utils.logger import log_swallowed
+                log_swallowed("poa: background warm-up compile failed "
+                              "(polish will compile on first use)", e)
 
         import threading
         self._warmup = threading.Thread(target=_compile, daemon=True,
@@ -1244,6 +1246,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 launch["pallas_key"] = key  # blamed on a fetch fault
                 return
             except Exception as e:
+                from .. import sanitize
+                sanitize.reraise_if_sanitizer(e)
                 self._note_pallas_failure(key, e)
                 # a packed-kernel-only fault must not cost the whole
                 # Pallas path: retry the int32 Mosaic kernels first
@@ -1254,6 +1258,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
                         launch["pallas_key"] = base_key
                         return
                     except Exception as e2:
+                        from .. import sanitize
+                        sanitize.reraise_if_sanitizer(e2)
                         self._note_pallas_failure(base_key, e2)
         launch["pallas_key"] = None
         self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, False, sw)
@@ -1352,6 +1358,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     fetch += [frozen, conv, bg_d, ed_d]
                 fetched = fetch_global(fetch)
         except Exception as e:
+            from .. import sanitize
+            sanitize.reraise_if_sanitizer(e)
             Lq, Lb, steps, Lq2 = launch["geom"]
             if retried:
                 raise
@@ -1393,6 +1401,11 @@ class TpuPoaConsensus(PallasDispatchMixin):
             bcodes, blen, covs, ever, dropped = fetched[:5]
             if collect is not None:
                 frozen_h, conv_h, bg_h, ed_h = fetched[5:]
+        from .. import sanitize
+        if sanitize.enabled():
+            sanitize.check_consensus_canaries(
+                bcodes, blen, covs, Lb=launch["geom"][1],
+                context=f"consensus group (nWp={nWp})")
         if collect is not None:
             # decision point: repack the stragglers only when few survive;
             # a mostly-unconverged group (noisy data rarely reaches an

@@ -123,8 +123,8 @@ def swar_ok() -> bool:
     backend whose 16-bit lowering misbehaves downgrades to the int32
     kernels instead of shipping corrupt alignments."""
     global _SWAR_OK
-    import os
-    if os.environ.get("RACON_TPU_SWAR", "1") == "0":
+    from .. import flags
+    if not flags.get_bool("RACON_TPU_SWAR"):
         return False  # global escape hatch / A-B switch, like DYNBOUND
     if _SWAR_OK is None:
         try:
@@ -150,6 +150,7 @@ def swar_ok() -> bool:
                 n[k], m[k] = len(q), ln
             args = (jnp.asarray(qrp), jnp.asarray(tp),
                     jnp.asarray(n), jnp.asarray(m))
+            # graftlint: disable=swar-guard (probe bucket: 256 + 2 < BIG16 by construction)
             dp, sp = _nw_wavefront_kernel(*args, max_len=max_len,
                                           band=band, swar=True)
             dx, sx = _nw_wavefront_kernel(*args, max_len=max_len,
@@ -164,7 +165,10 @@ def swar_ok() -> bool:
                 and np.array_equal(np.asarray(op_), np.asarray(ox))
                 and np.array_equal(np.asarray(fip), np.asarray(fix))
                 and np.array_equal(np.asarray(fjp), np.asarray(fjx)))
-        except Exception:
+        except Exception as e:
+            from ..utils.logger import log_swallowed
+            log_swallowed("swar: availability probe failed; packed "
+                          "int16 kernels disabled for this process", e)
             _SWAR_OK = False
     return _SWAR_OK
 

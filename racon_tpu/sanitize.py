@@ -1,0 +1,307 @@
+"""Runtime sanitizer (``RACON_TPU_SANITIZE=1``) — the dynamic half of
+graftlint (``tools/analysis``).
+
+Four independent detectors, all off unless the flag is set:
+
+- **SWAR shadow execution** — sampled packed-lane aligner chunks re-run
+  on the int32 kernels and every output is compared bit-for-bit
+  (:func:`should_shadow` / :func:`shadow_compare`).  The static guards
+  (``swar.swar_fits`` + the kernels' trace-time assert) make a real
+  int16 overflow unreachable *when they are in place*; the shadow path
+  is the net that catches the day someone loosens them.
+- **Kernel-output canaries** — cheap host-side invariant checks on every
+  fetched chunk/group (:func:`check_aligner_canaries`,
+  :func:`check_consensus_canaries`): a wrapped int16 lane surfaces as a
+  negative or out-of-range score, a poisoned f32 vote surfaces as an
+  out-of-alphabet consensus code or an impossible backbone length.
+- **jit-retrace budget** — :class:`PhaseRetraceBudget` snapshots the
+  total jit cache size across the kernel modules around a pipeline
+  phase and flags silent-recompile regressions (a shape leaking into
+  the batch geometry recompiles per chunk — historically a 30 s/chunk
+  stealth tax).
+- **Queue watchdog** — :class:`QueueWatchdog` arms a monitor over the
+  pipelined ``Polisher.run()`` bounded queue and dumps every thread's
+  stack to stderr when producer/consumer progress stalls past the
+  timeout (deadlock triage without attaching a debugger).
+
+Import cost is nil when disabled: numpy only, jax is touched lazily and
+only for the retrace scan.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Optional, Sequence
+
+from . import flags
+from .utils.logger import warn
+
+
+class SanitizerError(AssertionError):
+    """Base of every sanitizer-raised fault (an AssertionError so plain
+    test harnesses treat it as a hard failure)."""
+
+
+class SwarShadowMismatch(SanitizerError):
+    """The packed (SWAR) kernel output diverged from the int32 shadow."""
+
+
+class CanaryError(SanitizerError):
+    """A fetched kernel output violated a value-range invariant."""
+
+
+class RetraceBudgetExceeded(SanitizerError):
+    """A pipeline phase compiled more new jit entries than its budget."""
+
+
+def enabled() -> bool:
+    """Master switch, read from the environment on every call so tests
+    can toggle ``RACON_TPU_SANITIZE`` without re-importing."""
+    return flags.sanitize_enabled()
+
+
+def reraise_if_sanitizer(exc: BaseException) -> None:
+    """Guard for broad fallback handlers: a sanitizer fault must fail
+    the run, never be retried/downgraded like an ordinary kernel fault
+    (the Pallas fallback chains catch ``Exception``, and
+    :class:`SanitizerError` would otherwise vanish into them)."""
+    if isinstance(exc, SanitizerError):
+        raise exc
+
+
+# ------------------------------------------------------ shadow execution
+
+class ShadowSampler:
+    """Sampling gate for SWAR shadow execution: chunk 0 always, then
+    every ``RACON_TPU_SANITIZE_SAMPLE``-th chunk. One instance per
+    engine/run (TpuAligner owns one), so the first chunk of EVERY run
+    is checked — a process-global counter would leave short follow-up
+    runs unsampled. Thread-safe: chunks launch from pipelined producer
+    threads too."""
+
+    def __init__(self):
+        self._seen = 0
+        self._lock = threading.Lock()
+
+    def should_shadow(self) -> bool:
+        if not enabled():
+            return False
+        n = max(1, flags.get_int("RACON_TPU_SANITIZE_SAMPLE"))
+        with self._lock:
+            k = self._seen
+            self._seen += 1
+        return k % n == 0
+
+
+def shadow_compare(packed_out: Sequence, shadow_out: Sequence,
+                   names: Sequence[str], context: str) -> None:
+    """Bit-exact comparison of a packed-path output tuple against its
+    int32 shadow. Raises :class:`SwarShadowMismatch` naming the first
+    diverging output and the lane count that differs."""
+    import numpy as np
+
+    for name, a, b in zip(names, packed_out, shadow_out):
+        ah, bh = np.asarray(a), np.asarray(b)
+        if ah.shape != bh.shape:
+            raise SwarShadowMismatch(
+                f"{context}: {name} shape {ah.shape} != shadow {bh.shape}")
+        if not np.array_equal(ah, bh):
+            bad = int(np.count_nonzero(ah != bh))
+            raise SwarShadowMismatch(
+                f"{context}: {name} diverged from the int32 shadow on "
+                f"{bad}/{ah.size} lanes (packed-lane overflow or a "
+                f"kernel regression — the bit-exactness contract in "
+                f"ops/swar.py is broken)")
+
+
+# -------------------------------------------------------------- canaries
+
+def check_aligner_canaries(score, fi, fj, *, big: int,
+                           context: str) -> None:
+    """Host-side invariants on a fetched aligner chunk: scores are
+    edit counts in ``[0, big]`` (a wrapped int16 lane goes negative or
+    lands between the saturation classes' ceiling and ``big``), walk
+    endpoints are non-negative."""
+    import numpy as np
+
+    s = np.asarray(score)
+    if s.size and (int(s.min()) < 0 or int(s.max()) > big):
+        raise CanaryError(
+            f"{context}: score outside [0, {big}] "
+            f"(min {int(s.min())}, max {int(s.max())}) — packed-lane "
+            f"wraparound or kernel corruption")
+    for name, v in (("fi", fi), ("fj", fj)):
+        vh = np.asarray(v)
+        if vh.size and int(vh.min()) < 0:
+            raise CanaryError(f"{context}: negative walk endpoint {name}")
+
+
+def check_consensus_canaries(bcodes, blen, covs, *, Lb: int,
+                             context: str) -> None:
+    """Host-side invariants on a fetched consensus group: backbone codes
+    stay inside the 6-symbol alphabet (a NaN-poisoned f32 vote argmax or
+    a corrupted packed fetch shows up as code 6/7), lengths stay inside
+    the device buffer, coverage counts are non-negative."""
+    import numpy as np
+
+    bc = np.asarray(bcodes)
+    if bc.size and int(bc.max()) > 5:
+        raise CanaryError(
+            f"{context}: backbone code {int(bc.max())} outside the "
+            f"ACGTN- alphabet — vote matrix corruption")
+    bl = np.asarray(blen)
+    if bl.size and (int(bl.min()) < 0 or int(bl.max()) > Lb):
+        raise CanaryError(
+            f"{context}: backbone length outside [0, {Lb}]")
+    cv = np.asarray(covs)
+    if cv.size and int(cv.min()) < 0:
+        raise CanaryError(f"{context}: negative coverage count")
+
+
+# -------------------------------------------------------- retrace budget
+
+def retrace_count(prefixes: Sequence[str] = ("racon_tpu",)) -> int:
+    """Total live jit-cache entries across modules matching
+    ``prefixes`` — the monotone counter :class:`PhaseRetraceBudget`
+    differences.  Walks the already-imported modules for jitted
+    callables (objects exposing ``_cache_size``), so nothing has to
+    register itself. Phase budgets pass their own module scope so the
+    background consensus warm-up thread's compiles (``ops.poa``) are
+    not attributed to the concurrently-open align phase."""
+    total = 0
+    prefixes = tuple(prefixes)
+    for mod_name, mod in list(sys.modules.items()):
+        if not mod_name.startswith(prefixes):
+            continue
+        for attr in list(vars(mod).values()):
+            size = getattr(attr, "_cache_size", None)
+            if callable(size):
+                try:
+                    total += int(size())
+                except Exception:  # graftlint: disable=swallowed-exception (foreign jit internals)
+                    pass
+    return total
+
+
+class PhaseRetraceBudget:
+    """Context manager asserting a pipeline phase compiles at most
+    ``budget`` new jit entries (default from
+    ``RACON_TPU_SANITIZE_RETRACE_BUDGET``). No-op when the sanitizer is
+    off. The delta is recorded in :attr:`last_deltas` either way the
+    phase exits cleanly, so benches can report per-phase compile churn.
+
+    ``prefixes`` scopes the counted modules: the polisher's align phase
+    counts the aligner kernel modules only, so consensus compiles from
+    the concurrent warm-up thread (``warmup_async``) cannot push a
+    healthy align phase over budget. (The one-time availability probes
+    may still add a few shared-module entries — the default budget has
+    ample headroom for those; what the budget hunts is per-chunk
+    recompile *growth*.)"""
+
+    last_deltas: dict = {}
+
+    def __init__(self, phase: str, budget: Optional[int] = None,
+                 prefixes: Sequence[str] = ("racon_tpu",)):
+        self.phase = phase
+        self.budget = budget
+        self.prefixes = tuple(prefixes)
+        self._start = 0
+        self._armed = False
+
+    def __enter__(self):
+        self._armed = enabled()
+        if self._armed:
+            self._start = retrace_count(self.prefixes)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self._armed or exc_type is not None:
+            return False
+        delta = retrace_count(self.prefixes) - self._start
+        PhaseRetraceBudget.last_deltas[self.phase] = delta
+        budget = (self.budget if self.budget is not None
+                  else flags.get_int("RACON_TPU_SANITIZE_RETRACE_BUDGET"))
+        if delta > budget:
+            raise RetraceBudgetExceeded(
+                f"phase {self.phase!r} compiled {delta} new jit entries "
+                f"(budget {budget}) — a shape is leaking into the batch "
+                f"geometry and forcing silent recompiles")
+        return False
+
+
+# -------------------------------------------------------- queue watchdog
+
+def dump_all_stacks(reason: str, stream=None) -> None:
+    """Write every live thread's stack to ``stream`` (stderr default) —
+    the deadlock-triage dump the queue watchdog fires."""
+    stream = stream if stream is not None else sys.stderr
+    lines = [f"[racon_tpu::sanitize] watchdog: {reason} — "
+             f"dumping {threading.active_count()} thread stacks"]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        lines.extend(l.rstrip("\n")
+                     for l in traceback.format_stack(frame))
+    print("\n".join(lines), file=stream)
+    stream.flush()
+
+
+class QueueWatchdog:
+    """Stall monitor for a bounded producer/consumer queue: call
+    :meth:`beat` on every put/get; if no beat lands for ``timeout``
+    seconds the watchdog dumps all thread stacks (once per stall) and
+    counts the firing. Passive — it reports, it never kills the run."""
+
+    def __init__(self, timeout: float, name: str = "queue",
+                 stream=None):
+        self.timeout = float(timeout)
+        self.name = name
+        self.fired = 0
+        self._stream = stream
+        self._last = time.monotonic()
+        self._dumped_for_beat = -1.0
+        self._stop = threading.Event()
+        self.stalled = threading.Event()  # test hook: set on each dump
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self.stalled.clear()
+
+    def start(self) -> "QueueWatchdog":
+        self._thread = threading.Thread(
+            target=self._watch, name=f"racon-watchdog-{self.name}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+
+    def _watch(self) -> None:
+        poll = max(0.01, self.timeout / 4.0)
+        while not self._stop.wait(poll):
+            last = self._last
+            if (time.monotonic() - last > self.timeout
+                    and self._dumped_for_beat != last):
+                self._dumped_for_beat = last
+                self.fired += 1
+                warn(f"{self.name} stalled for > {self.timeout:.1f}s")
+                dump_all_stacks(
+                    f"{self.name} made no progress for "
+                    f"{self.timeout:.1f}s", self._stream)
+                self.stalled.set()
+
+
+def queue_watchdog(name: str) -> Optional[QueueWatchdog]:
+    """A started watchdog with the flag-configured timeout when the
+    sanitizer is on, else None (callers guard beats with ``if wd:``)."""
+    if not enabled():
+        return None
+    return QueueWatchdog(
+        flags.get_float("RACON_TPU_SANITIZE_WATCHDOG_S"), name).start()

@@ -11,6 +11,29 @@ from __future__ import annotations
 import sys
 import time
 
+_seen_swallowed: set = set()
+
+
+def warn(message: str) -> None:
+    """Process-wide warning line on stderr (stdout carries the polished
+    FASTA). The sanctioned sink for non-fatal fault reports — the
+    graftlint ``swallowed-exception`` rule accepts handlers that route
+    through here (or :func:`log_swallowed` / ``warnings.warn``)."""
+    print(f"[racon_tpu] warning: {message}", file=sys.stderr)
+
+
+def log_swallowed(context: str, exc: BaseException) -> None:
+    """Report a swallowed exception: every ``except Exception`` site that
+    deliberately continues (fallback paths, optimization failures) calls
+    this so no fault disappears silently. De-duplicated per (context,
+    exception type): fallback paths can swallow the same fault once per
+    chunk, and one line per cause is signal while thousands are noise."""
+    key = (context, type(exc).__name__)
+    if key in _seen_swallowed:
+        return
+    _seen_swallowed.add(key)
+    warn(f"{context}: swallowed {type(exc).__name__}: {exc}")
+
 
 class Logger:
     """Wall-clock stage logger writing to stderr.

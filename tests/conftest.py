@@ -4,12 +4,16 @@ real multi-chip path separately via __graft_entry__.dryrun_multichip)."""
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# dependency-free (and jax-free), so it is safe to consult before the
+# XLA backend configuration below
+from racon_tpu import flags as racon_flags
 
-if os.environ.get("RACON_TPU_TEST_REAL", "") != "1":
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+if not racon_flags.get_bool("RACON_TPU_TEST_REAL"):
     # The environment may pre-register an accelerator plugin (and pin
     # jax_platforms) from sitecustomize, so an env var alone is not enough:
     # override the config before any backend initializes.

@@ -14,13 +14,13 @@ the ``-f`` code path exercised in every run.
 """
 
 import gzip
-import os
 
 import pytest
 
+from racon_tpu import flags as racon_flags
 from racon_tpu.core.polisher import PolisherType, create_polisher
 
-RUN_SLOW = os.environ.get("RACON_TPU_SLOW", "") == "1"
+RUN_SLOW = racon_flags.get_bool("RACON_TPU_SLOW")
 slow = pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")
 
 

@@ -1,0 +1,105 @@
+"""graftlint (tools/analysis) — rule self-tests, pragma semantics, the
+repo-wide zero-findings gate, and the flags-registry contract."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from racon_tpu import flags
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_selftest_fixtures():
+    """Every rule fires on its seeded fixture and stays quiet on the
+    clean twin (exact counts — see tools/analysis/selftest.py)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from tools.analysis.selftest import run_selftest
+        assert run_selftest(verbose=False) == 0
+    finally:
+        sys.path.remove(str(REPO))
+
+
+def test_repo_is_clean():
+    """The acceptance gate: zero unsuppressed findings over racon_tpu/
+    (and the support trees CI lints)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--quiet",
+         "racon_tpu", "tests", "tools", "bench.py"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_pragma_without_reason_does_not_suppress(tmp_path):
+    sys.path.insert(0, str(REPO))
+    try:
+        from tools.analysis import run
+        bad = tmp_path / "m.py"
+        bad.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:  # graftlint: disable=swallowed-exception\n"
+            "        pass\n")
+        reported, suppressed = run([str(bad)], scoped=False)
+        assert len(reported) == 1 and not suppressed
+        assert "missing its (reason)" in reported[0].message
+
+        good = tmp_path / "ok.py"
+        good.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:"
+            "  # graftlint: disable=swallowed-exception (why)\n"
+            "        pass\n")
+        reported, suppressed = run([str(good)], scoped=False)
+        assert not reported and len(suppressed) == 1
+    finally:
+        sys.path.remove(str(REPO))
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "m.py"
+    bad.write_text("import os\n"
+                   "x = os.environ.get('RACON_TPU_BOGUS', '')\n")
+    rc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--quiet", str(bad)],
+        cwd=REPO, capture_output=True, text=True)
+    assert rc.returncode == 1
+    assert "env-flag-registry" in rc.stdout
+
+
+# ------------------------------------------------------------ flags registry
+
+def test_undeclared_flag_raises():
+    with pytest.raises(KeyError, match="not declared"):
+        # graftlint: disable=env-flag-registry (negative test: must raise)
+        flags.get_bool("RACON_TPU_NOT_A_FLAG")
+
+
+def test_declared_flags_have_docs():
+    for f in flags.REGISTRY.values():
+        assert f.name.startswith("RACON_TPU_")
+        assert f.help.strip()
+
+
+def test_bool_semantics(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_SWAR", "0")
+    assert not flags.get_bool("RACON_TPU_SWAR")
+    monkeypatch.setenv("RACON_TPU_SWAR", "off")
+    assert not flags.get_bool("RACON_TPU_SWAR")
+    monkeypatch.setenv("RACON_TPU_SWAR", "1")
+    assert flags.get_bool("RACON_TPU_SWAR")
+    monkeypatch.delenv("RACON_TPU_SWAR")
+    assert flags.get_bool("RACON_TPU_SWAR")  # registry default
+
+
+def test_readme_table_is_current():
+    """The README 'Environment flags' section must match the generated
+    table exactly (regenerate with `python -m racon_tpu.flags`)."""
+    assert flags.check_readme(str(REPO / "README.md")), \
+        "stale README flags table — run `python -m racon_tpu.flags`"

@@ -15,7 +15,9 @@ import sys
 
 import pytest
 
-RUN_SLOW = os.environ.get("RACON_TPU_SLOW", "") == "1"
+from racon_tpu import flags as racon_flags
+
+RUN_SLOW = racon_flags.get_bool("RACON_TPU_SLOW")
 
 WORKER = pathlib.Path(__file__).parent / "multihost_worker.py"
 

@@ -12,16 +12,15 @@ The raw backbone scores 8765 — any value near 1300-1600 means the pipeline
 is polishing correctly.
 """
 
-import os
-
 import pytest
 
+from racon_tpu import flags as racon_flags
 from racon_tpu import native
 from racon_tpu.core.polisher import PolisherType, create_polisher
 from racon_tpu.core.sequence import Sequence
 from racon_tpu.io import parse_fasta
 
-RUN_SLOW = os.environ.get("RACON_TPU_SLOW", "") == "1"
+RUN_SLOW = racon_flags.get_bool("RACON_TPU_SLOW")
 
 
 def polish(data_dir, reads, overlaps, **kw):

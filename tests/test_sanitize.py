@@ -1,0 +1,285 @@
+"""Runtime-sanitizer coverage (``RACON_TPU_SANITIZE=1``).
+
+The two acceptance halves from the graftlint issue:
+
+- a **seeded int16 overflow** (packed-path score corruption injected at
+  the kernel seam — the static guards make a real overflow unreachable,
+  which is exactly what they are for) that ONLY the int32 shadow
+  execution catches: the unsanitized run ships the corrupt result
+  silently;
+- a **deliberately stalled consensus consumer** that triggers the
+  pipelined-polish queue watchdog's all-thread stack dump within the
+  timeout.
+
+Plus unit coverage for the canaries and the jit-retrace phase budget.
+"""
+
+import io
+import time
+
+import numpy as np
+import pytest
+
+from racon_tpu import sanitize
+
+
+@pytest.fixture
+def sanitize_on(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_SANITIZE", "1")
+    monkeypatch.setenv("RACON_TPU_SANITIZE_SAMPLE", "1")
+
+
+def _pairs(n=6, ln=120, seed=3):
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    pairs = []
+    for _ in range(n):
+        t = bases[rng.integers(0, 4, ln)]
+        q = t.copy()
+        flips = rng.random(ln) < 0.15
+        q[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        pairs.append((q.tobytes(), t.tobytes()))
+    return pairs
+
+
+def _seed_packed_corruption(monkeypatch):
+    """Inject the failure mode the SWAR guards exist to prevent: the
+    packed path's scores come back off-by-one (what a wrapped int16
+    lane produces), while the int32 path stays correct. Bypasses the
+    bit-exactness probe — a real overflow would bypass it too, since
+    the probe runs once at a safe small shape."""
+    from racon_tpu.ops import nw, swar
+
+    real = nw._nw_wavefront_kernel
+
+    def corrupt(*args, **kw):
+        packed, score = real(*args, **kw)
+        if kw.get("swar"):
+            score = score + 1
+        return packed, score
+
+    monkeypatch.setattr(nw, "_nw_wavefront_kernel", corrupt)
+    monkeypatch.setattr(swar, "_SWAR_OK", True)
+
+
+# ------------------------------------------------------ shadow execution
+
+def test_swar_shadow_catches_seeded_overflow(sanitize_on, monkeypatch):
+    from racon_tpu.ops.nw import TpuAligner
+
+    _seed_packed_corruption(monkeypatch)
+    aligner = TpuAligner(use_swar=True)
+    with pytest.raises(sanitize.SwarShadowMismatch, match="score"):
+        aligner.align_batch(_pairs())
+
+
+def test_seeded_overflow_silent_without_sanitizer(monkeypatch):
+    """The control half: with the sanitizer off, the same corruption
+    sails through — results are produced with no error, which is why
+    the shadow path exists."""
+    from racon_tpu.ops.nw import TpuAligner
+
+    monkeypatch.delenv("RACON_TPU_SANITIZE", raising=False)
+    _seed_packed_corruption(monkeypatch)
+    aligner = TpuAligner(use_swar=True)
+    pairs = _pairs()
+    out = aligner.align_batch(pairs)
+    assert len(out) == len(pairs)  # shipped silently
+
+
+def test_swar_path_clean_under_sanitizer(sanitize_on):
+    """No seeded fault: the sanitized SWAR run passes the shadow check
+    and produces the same CIGARs as the int32 path."""
+    from racon_tpu.ops.nw import TpuAligner
+
+    pairs = _pairs(seed=11)
+    a = TpuAligner(use_swar=True).align_batch(pairs)
+    b = TpuAligner(use_swar=False).align_batch(pairs)
+    assert a == b
+
+
+def test_sanitizer_error_pierces_pallas_fallback(sanitize_on,
+                                                 monkeypatch):
+    """A shadow mismatch must fail the run even on the Pallas-enabled
+    path — the try-Pallas-then-XLA fallback chains catch Exception and
+    would otherwise silently downgrade the chunk and swallow the
+    sanitizer's verdict."""
+    from racon_tpu.ops.nw import TpuAligner
+
+    aligner = TpuAligner(use_swar=True)
+    monkeypatch.setattr(TpuAligner, "_use_pallas", lambda self, key: True)
+
+    def boom(*a, **kw):
+        raise sanitize.SwarShadowMismatch("seeded divergence")
+
+    monkeypatch.setattr(aligner, "_dispatch", boom)
+    with pytest.raises(sanitize.SwarShadowMismatch, match="seeded"):
+        aligner.align_batch(_pairs(n=2))
+
+
+def test_shadow_compare_unit():
+    x = np.arange(8)
+    sanitize.shadow_compare((x,), (x.copy(),), ("x",), "unit")  # equal: ok
+    with pytest.raises(sanitize.SwarShadowMismatch, match="2/8"):
+        y = x.copy()
+        y[3:5] += 1
+        sanitize.shadow_compare((x,), (y,), ("x",), "unit")
+
+
+def test_shadow_sampler(sanitize_on, monkeypatch):
+    monkeypatch.setenv("RACON_TPU_SANITIZE_SAMPLE", "4")
+    s = sanitize.ShadowSampler()
+    hits = [s.should_shadow() for _ in range(8)]
+    assert hits == [True, False, False, False, True, False, False, False]
+    # a fresh run gets a fresh sampler: its first chunk is always checked
+    assert sanitize.ShadowSampler().should_shadow()
+    monkeypatch.setenv("RACON_TPU_SANITIZE", "0")
+    assert not sanitize.ShadowSampler().should_shadow()
+
+
+# --------------------------------------------------------------- canaries
+
+def test_aligner_canary_catches_wraparound():
+    ok = np.array([0, 5, 1 << 28])
+    sanitize.check_aligner_canaries(ok, np.zeros(3), np.zeros(3),
+                                    big=1 << 28, context="t")
+    with pytest.raises(sanitize.CanaryError, match="wraparound"):
+        sanitize.check_aligner_canaries(np.array([5, -3]), np.zeros(2),
+                                        np.zeros(2), big=1 << 28,
+                                        context="t")
+    with pytest.raises(sanitize.CanaryError, match="endpoint"):
+        sanitize.check_aligner_canaries(ok, np.array([0, -1, 0]),
+                                        np.zeros(3), big=1 << 28,
+                                        context="t")
+
+
+def test_consensus_canary_catches_corruption():
+    bc = np.array([[0, 3, 5]], np.uint8)
+    sanitize.check_consensus_canaries(bc, np.array([3]), np.ones((1, 3)),
+                                      Lb=8, context="t")
+    with pytest.raises(sanitize.CanaryError, match="alphabet"):
+        sanitize.check_consensus_canaries(np.array([[0, 7]], np.uint8),
+                                          np.array([2]), np.ones((1, 2)),
+                                          Lb=8, context="t")
+    with pytest.raises(sanitize.CanaryError, match="length"):
+        sanitize.check_consensus_canaries(bc, np.array([9]),
+                                          np.ones((1, 3)), Lb=8,
+                                          context="t")
+
+
+# --------------------------------------------------------- retrace budget
+
+def test_retrace_budget(sanitize_on):
+    import jax.numpy as jnp
+
+    from racon_tpu.ops import nw
+
+    def run(batch):
+        qrp = jnp.zeros((batch, 64 + 256 + 128), jnp.uint8)
+        tp = jnp.zeros((batch, 64 + 256 + 128), jnp.uint8)
+        n = jnp.ones((batch,), jnp.int32)
+        m = jnp.ones((batch,), jnp.int32)
+        nw._nw_wavefront_kernel(qrp, tp, n, m, max_len=256, band=128)
+
+    run(2)  # warm the shape outside any budget
+    with sanitize.PhaseRetraceBudget("warm", budget=0):
+        run(2)  # cache hit: zero new entries
+    with pytest.raises(sanitize.RetraceBudgetExceeded, match="cold"):
+        with sanitize.PhaseRetraceBudget("cold", budget=0):
+            run(4)  # new batch shape: one silent recompile
+
+
+def test_retrace_budget_failure_in_run_raises_not_hangs(tmp_path,
+                                                        monkeypatch):
+    """When the consensus-phase budget fires inside the pipelined
+    run(), the error must propagate — the producer is already retired
+    by then, so the fault path must not block draining the queue."""
+    from racon_tpu.core.polisher import create_polisher
+    from test_columnar_init import write_synthetic_assembly
+
+    monkeypatch.setenv("RACON_TPU_SANITIZE", "1")
+    # align phase (enter/exit = first two reads) sees delta 0; the
+    # consensus phase exit then reports a huge delta
+    reads = iter([0, 0, 0, 10**6])
+    monkeypatch.setattr(sanitize, "retrace_count",
+                        lambda *a: next(reads, 10**6))
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=37, n_contigs=1,
+                                          contig=1500)
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=2)
+    with pytest.raises(sanitize.RetraceBudgetExceeded, match="consensus"):
+        p.run(True)
+
+
+def test_retrace_budget_noop_when_disabled(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_SANITIZE", raising=False)
+    import jax.numpy as jnp
+
+    from racon_tpu.ops import nw
+
+    with sanitize.PhaseRetraceBudget("off", budget=0):
+        qrp = jnp.zeros((8, 64 + 256 + 128), jnp.uint8)
+        tp = jnp.zeros((8, 64 + 256 + 128), jnp.uint8)
+        nw._nw_wavefront_kernel(qrp, tp, jnp.ones((8,), jnp.int32),
+                                jnp.ones((8,), jnp.int32),
+                                max_len=256, band=128)
+
+
+# ---------------------------------------------------------- queue watchdog
+
+def test_queue_watchdog_dumps_stacks_on_stall():
+    buf = io.StringIO()
+    wd = sanitize.QueueWatchdog(0.2, "test-queue", stream=buf).start()
+    try:
+        wd.beat()
+        assert wd.stalled.wait(5.0), "watchdog never fired"
+    finally:
+        wd.stop()
+    out = buf.getvalue()
+    assert "test-queue made no progress" in out
+    assert "MainThread" in out  # every thread's stack is in the dump
+    assert wd.fired == 1  # one dump per stall, not one per poll
+
+
+def test_queue_watchdog_quiet_while_beating():
+    buf = io.StringIO()
+    wd = sanitize.QueueWatchdog(0.3, "beating", stream=buf).start()
+    try:
+        for _ in range(6):
+            wd.beat()
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert wd.fired == 0 and buf.getvalue() == ""
+
+
+def test_stalled_consumer_triggers_watchdog(tmp_path, monkeypatch,
+                                            capsys):
+    """Integration half: a Polisher.run() whose consensus consumer
+    deliberately stalls past the timeout gets the all-thread stack dump
+    on stderr (and the run still completes — the watchdog reports, it
+    never kills)."""
+    from racon_tpu.core.polisher import create_polisher
+    from test_columnar_init import write_synthetic_assembly
+
+    monkeypatch.setenv("RACON_TPU_SANITIZE", "1")
+    monkeypatch.setenv("RACON_TPU_SANITIZE_WATCHDOG_S", "0.3")
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=29, n_contigs=1,
+                                          contig=2000)
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=2)
+    real_run = p.consensus.run
+    state = {"stalled": False}
+
+    def stalling(windows, trim, progress=None):
+        if not state["stalled"]:
+            state["stalled"] = True
+            time.sleep(1.2)  # consumer wedged well past the timeout
+        return real_run(windows, trim)
+
+    p.consensus.run = stalling
+    out = p.run(True)
+    assert len(out) == 1  # the run itself still completes
+    err = capsys.readouterr().err
+    assert "watchdog" in err and "dumping" in err
+    # the dump carries the wedged consumer's frame (the producer thread
+    # finished long before the stall, so only live threads appear)
+    assert "in stalling" in err

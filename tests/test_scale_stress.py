@@ -13,9 +13,11 @@ import sys
 
 import pytest
 
+from racon_tpu import flags as racon_flags
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-RUN_SLOW = os.environ.get("RACON_TPU_SLOW", "") == "1"
+RUN_SLOW = racon_flags.get_bool("RACON_TPU_SLOW")
 
 
 @pytest.mark.skipif(not RUN_SLOW, reason="set RACON_TPU_SLOW=1")

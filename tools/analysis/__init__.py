@@ -1,0 +1,174 @@
+"""graftlint — the repo's first-party JAX-hazard linter.
+
+AST-based and repo-aware: rules consult a project-wide function index,
+jit-reachability with interprocedural taint, and a logging-function
+closure (see :mod:`tools.analysis.astutil` /
+:mod:`tools.analysis.rules`).  Run it as::
+
+    python -m tools.analysis racon_tpu tests tools bench.py
+    python -m tools.analysis --selftest        # fixture-based rule tests
+    python -m tools.analysis --list            # rule inventory
+
+Suppression: a finding is silenced by a pragma **with a reason** on the
+finding line or the line above::
+
+    except Exception:  # graftlint: disable=swallowed-exception (probe)
+
+A pragma without a reason does not suppress (the finding is reported
+with a note), so every escape documents its justification.  Exit code 0
+means zero unsuppressed findings.
+
+The runtime half of the tool lives in ``racon_tpu/sanitize.py``
+(``RACON_TPU_SANITIZE=1``): SWAR int32 shadow execution, kernel-output
+canaries, the jit-retrace phase budget and the pipeline queue watchdog.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .astutil import Module, Project, load_module
+from .rules import ALL_RULES, RULES_BY_NAME, Finding, Rule
+
+_PRAGMA = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_\-,\s]+?)"
+    r"(?:\s*\((?P<reason>[^)]*)\))?\s*$")
+
+EXCLUDE_PARTS = {"__pycache__", "fixtures", ".git"}
+
+
+def pragma_rules(line: str) -> Optional[Tuple[List[str], str]]:
+    """(rule names, reason) of a pragma on ``line``, else None."""
+    m = _PRAGMA.search(line)
+    if not m:
+        return None
+    rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+    return rules, (m.group("reason") or "").strip()
+
+
+def collect_files(paths: Sequence[str]) -> List[pathlib.Path]:
+    files: List[pathlib.Path] = []
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not (set(f.parts) & EXCLUDE_PARTS):
+                    files.append(f)
+        elif p.suffix == ".py":
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"not a Python file or directory: {raw}")
+    return files
+
+
+def _rel(path: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(
+            pathlib.Path.cwd().resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def load_project(paths: Sequence[str]) -> Project:
+    return Project([load_module(f, _rel(f)) for f in collect_files(paths)])
+
+
+def apply_pragmas(module: Module,
+                  findings: Iterable[Finding]) -> Tuple[List[Finding],
+                                                        List[Finding]]:
+    """Split findings into (reported, suppressed) per the module's
+    pragmas. Unknown rule names in pragmas and missing reasons become
+    extra findings — the pragma escape polices itself."""
+    reported: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        verdict = None
+        for line_no in (f.line, f.line - 1):
+            parsed = pragma_rules(module.line(line_no))
+            if parsed is None:
+                continue
+            rules, reason = parsed
+            if f.rule in rules:
+                verdict = (line_no, reason)
+                break
+        if verdict is None:
+            reported.append(f)
+        elif not verdict[1]:
+            f.message += " [pragma present but missing its (reason)]"
+            reported.append(f)
+        else:
+            suppressed.append(f)
+    return reported, suppressed
+
+
+def check_pragma_hygiene(module: Module) -> List[Finding]:
+    """Pragmas naming unknown rules are themselves findings (a typo'd
+    pragma silently suppresses nothing — surface it)."""
+    out: List[Finding] = []
+    for i, line in enumerate(module.lines, 1):
+        parsed = pragma_rules(line)
+        if parsed is None:
+            continue
+        for rule in parsed[0]:
+            if rule not in RULES_BY_NAME:
+                out.append(Finding(
+                    "pragma", module.rel, i,
+                    f"pragma names unknown rule {rule!r} (known: "
+                    f"{', '.join(sorted(RULES_BY_NAME))})"))
+    return out
+
+
+def run(paths: Sequence[str],
+        rules: Optional[Sequence[Rule]] = None,
+        scoped: bool = True) -> Tuple[List[Finding], List[Finding]]:
+    """Lint ``paths``; returns (reported, suppressed). ``scoped=False``
+    disables per-rule path scoping (the selftest fixtures live outside
+    the rules' production scopes)."""
+    project = load_project(paths)
+    rules = list(rules if rules is not None else ALL_RULES)
+    reported: List[Finding] = []
+    suppressed: List[Finding] = []
+    for module in project.modules:
+        found: List[Finding] = []
+        for rule in rules:
+            if scoped and not rule.applies(module.rel):
+                continue
+            found.extend(rule.check(project, module))
+        rep, sup = apply_pragmas(module, found)
+        reported.extend(rep)
+        suppressed.extend(sup)
+        reported.extend(check_pragma_hygiene(module))
+    reported.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return reported, suppressed
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--list" in argv:
+        for rule in ALL_RULES:
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.name}: {doc}")
+        return 0
+    if "--selftest" in argv:
+        from .selftest import run_selftest
+        return run_selftest()
+    quiet = "--quiet" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        print("usage: python -m tools.analysis [--selftest|--list] "
+              "PATH [PATH...]", file=sys.stderr)
+        return 2
+    try:
+        reported, suppressed = run(paths)
+    except (FileNotFoundError, SyntaxError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    for f in reported:
+        print(f)
+    if not quiet:
+        print(f"graftlint: {len(reported)} finding(s), "
+              f"{len(suppressed)} suppressed by pragma", file=sys.stderr)
+    return 1 if reported else 0

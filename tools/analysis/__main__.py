@@ -1,0 +1,7 @@
+"""``python -m tools.analysis`` entry point."""
+
+import sys
+
+from . import main
+
+sys.exit(main())

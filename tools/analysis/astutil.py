@@ -1,0 +1,474 @@
+"""AST plumbing shared by the graftlint rules.
+
+Everything here is *static*: source files are parsed, never imported, so
+the linter runs without jax (and on broken code). The central products:
+
+- :class:`Project` — the parsed module set plus repo-aware indexes: a
+  name-keyed function index, the set of jit-traced roots (``@jax.jit``
+  and friends, plus Pallas kernels by their positional ``*_ref`` /
+  keyword-only-static convention), tracer-reachability over the repo
+  call graph, and an interprocedural **taint** of traced values that the
+  ``tracer-leak`` rule consumes.
+- :func:`dotted` — best-effort dotted name of an expression
+  (``jax.jit``, ``os.environ.get``), the workhorse of call matching.
+
+The taint model: a name is *traced* if it is a non-static parameter of a
+jit-traced function, or derives from one through assignments, or is the
+result of a ``jnp.`` / ``lax.`` / ``jax.`` call.  Shape/dtype attribute
+reads and ``isinstance``/``len``/``type`` calls launder taint (their
+results are static under tracing).  Taint flows across calls resolved in
+the repo (positional and keyword args mapped onto the callee signature),
+and into nested functions (tracing callbacks for ``scan``/``vmap``)
+whose own parameters are traced by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+JIT_NAMES = {"jax.jit", "jit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+# attribute reads that are static under tracing (reading them off a
+# tracer yields a concrete Python value)
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+# calls that are static under tracing even on traced operands
+STATIC_CALLS = {"isinstance", "len", "type", "hasattr", "callable", "id",
+                "repr", "str", "format"}
+# call prefixes that produce traced values
+TRACED_PREFIXES = ("jnp.", "lax.", "jax.", "pl.", "pltpu.")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+@dataclass
+class Module:
+    path: pathlib.Path
+    rel: str                      # posix path relative to the lint root
+    tree: ast.AST
+    lines: List[str]
+
+    def line(self, n: int) -> str:
+        return self.lines[n - 1] if 0 < n <= len(self.lines) else ""
+
+
+def load_module(path: pathlib.Path, rel: str) -> Module:
+    src = path.read_text(encoding="utf-8")
+    return Module(path, rel, ast.parse(src, filename=str(path)),
+                  src.splitlines())
+
+
+@dataclass
+class FuncInfo:
+    module: Module
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    qualname: str
+    parent: Optional["FuncInfo"]  # lexically enclosing function
+    class_name: Optional[str]
+    is_jit_root: bool = False
+    is_kernel_root: bool = False
+    static_argnames: Set[str] = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def params(self, *, drop_self: bool = False) -> List[str]:
+        a = self.node.args
+        names = ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args])
+        if drop_self and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+    def kwonly_params(self) -> List[str]:
+        return [p.arg for p in self.node.args.kwonlyargs]
+
+    def all_params(self) -> List[str]:
+        extra = []
+        if self.node.args.vararg:
+            extra.append(self.node.args.vararg.arg)
+        if self.node.args.kwarg:
+            extra.append(self.node.args.kwarg.arg)
+        return self.params() + self.kwonly_params() + extra
+
+
+def _jit_decoration(dec: ast.AST) -> Optional[Set[str]]:
+    """If ``dec`` marks a jit root, return its static_argnames set."""
+    if dotted(dec) in JIT_NAMES:
+        return set()
+    if isinstance(dec, ast.Call):
+        fn = dotted(dec.func)
+        if fn in JIT_NAMES:
+            return _static_argnames(dec)
+        if fn in PARTIAL_NAMES and dec.args \
+                and dotted(dec.args[0]) in JIT_NAMES:
+            return _static_argnames(dec)
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return {v.value}
+    return set()
+
+
+def _is_pallas_kernel(node: ast.AST) -> bool:
+    """Pallas kernels follow the repo convention: positional ``*_ref``
+    parameters (Refs, traced) plus keyword-only static geometry."""
+    names = [p.arg for p in node.args.posonlyargs + node.args.args]
+    return any(n.endswith("_ref") for n in names)
+
+
+class Project:
+    """Parsed modules plus lazily-built repo-wide indexes."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+        self.functions: List[FuncInfo] = []
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.func_of_node: Dict[ast.AST, FuncInfo] = {}
+        self._index()
+        self._taint: Optional[Dict[int, Set[str]]] = None
+        self._reachable: Optional[Set[int]] = None
+        self._logging: Optional[Set[int]] = None
+
+    # ------------------------------------------------------------ indexing
+
+    def _index(self) -> None:
+        for mod in self.modules:
+            self._index_scope(mod, mod.tree, None, None, prefix="")
+
+    def _index_scope(self, mod, node, parent_fn, class_name, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                statics: Set[str] = set()
+                is_jit = False
+                for dec in child.decorator_list:
+                    s = _jit_decoration(dec)
+                    if s is not None:
+                        is_jit, statics = True, s
+                is_kernel = (not is_jit and child.name.endswith("_kernel")
+                             and _is_pallas_kernel(child))
+                fi = FuncInfo(mod, child, qual, parent_fn, class_name,
+                              is_jit, is_kernel, statics)
+                self.functions.append(fi)
+                self.by_name.setdefault(child.name, []).append(fi)
+                self.func_of_node[child] = fi
+                self._index_scope(mod, child, fi, class_name,
+                                  prefix=qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                self._index_scope(mod, child, parent_fn, child.name,
+                                  prefix=f"{prefix}{child.name}.")
+            else:
+                self._index_scope(mod, child, parent_fn, class_name, prefix)
+
+    def resolve(self, call: ast.Call) -> List[FuncInfo]:
+        """Candidate repo definitions for a call, by terminal name."""
+        name = last_segment(dotted(call.func))
+        return self.by_name.get(name, []) if name else []
+
+    def enclosing(self, fi: FuncInfo) -> List[FuncInfo]:
+        chain = []
+        cur = fi.parent
+        while cur is not None:
+            chain.append(cur)
+            cur = cur.parent
+        return chain
+
+    # --------------------------------------------------- jit roots + taint
+
+    def roots(self) -> List[Tuple[FuncInfo, Set[str]]]:
+        """(function, initially traced parameter names) for every
+        jit-traced entry point: jit-decorated defs (non-static params)
+        and Pallas kernels (positional Ref params)."""
+        out = []
+        for fi in self.functions:
+            if fi.is_jit_root:
+                traced = {p for p in fi.all_params()
+                          if p not in fi.static_argnames
+                          and p not in ("self", "cls")}
+                out.append((fi, traced))
+            elif fi.is_kernel_root:
+                out.append((fi, set(fi.params())))
+        return out
+
+    def taints(self) -> Dict[int, Set[str]]:
+        """Fixpoint map ``id(FuncInfo) -> traced local names`` over every
+        tracer-reachable function (the side product is
+        :meth:`reachable`)."""
+        if self._taint is not None:
+            return self._taint
+        param_taint: Dict[int, Set[str]] = {}
+        info: Dict[int, FuncInfo] = {}
+        work: List[FuncInfo] = []
+
+        def seed(fi: FuncInfo, names: Set[str]) -> None:
+            key = id(fi)
+            info[key] = fi
+            prev = param_taint.get(key)
+            if prev is None or not names <= prev:
+                param_taint[key] = (prev or set()) | names
+                if fi not in work:
+                    work.append(fi)
+
+        for fi, traced in self.roots():
+            seed(fi, traced)
+
+        final: Dict[int, Set[str]] = {}
+        guard = 0
+        while work and guard < 10000:
+            guard += 1
+            fi = work.pop(0)
+            names = self._intra_taint(fi, param_taint[id(fi)])
+            final[id(fi)] = names
+            # propagate into repo callees through mapped arguments
+            for call in iter_own_calls(fi.node):
+                for callee in self.resolve(call):
+                    mapped = map_call_args(call, callee)
+                    if mapped is None:
+                        continue
+                    tainted_params = {
+                        p for p, expr in mapped.items()
+                        if expr is not None
+                        and self.expr_tainted(expr, names)}
+                    seed(callee, tainted_params)
+            # directly nested defs: closure names carry the enclosing
+            # taint; parameters are tainted by how the function is used —
+            # direct calls map argument taint (handled above via
+            # resolve()), while *escaping* uses (passed to scan/vmap/
+            # pallas_call, stored) trace every parameter except ones a
+            # functools.partial binds to untainted values
+            for child in ast.walk(fi.node):
+                sub = self.func_of_node.get(child)
+                if sub is not None and sub.parent is fi:
+                    seed(sub, names & free_names(sub.node))
+                    esc = self._escape_taint(fi, sub, names)
+                    if esc:
+                        seed(sub, esc)
+        self._taint = final
+        self._reachable = set(final)
+        return final
+
+    def reachable(self) -> Set[int]:
+        self.taints()
+        return self._reachable or set()
+
+    def _escape_taint(self, fi: FuncInfo, sub: FuncInfo,
+                      names: Set[str]) -> Set[str]:
+        """Traced parameters of nested ``sub`` implied by how ``fi``
+        *uses* it beyond direct calls. A ``functools.partial(sub, ...)``
+        binds the mapped params to the taint of the bound expressions;
+        any other escaping reference (an argument to scan/vmap/
+        pallas_call, an assignment) traces every parameter."""
+        out: Set[str] = set()
+        covered: Set[int] = set()
+        params = sub.params()
+        for call in iter_own_calls(fi.node):
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id == sub.name:
+                covered.add(id(call.func))  # direct call: mapped above
+            elif dotted(call.func) in PARTIAL_NAMES and call.args \
+                    and isinstance(call.args[0], ast.Name) \
+                    and call.args[0].id == sub.name:
+                covered.add(id(call.args[0]))
+                bound: Dict[str, ast.AST] = {}
+                for p, a in zip(params, call.args[1:]):
+                    bound[p] = a
+                for kw in call.keywords:
+                    if kw.arg is not None:
+                        bound[kw.arg] = kw.value
+                for p in sub.all_params():
+                    expr = bound.get(p)
+                    if expr is None or self.expr_tainted(expr, names):
+                        out.add(p)
+        for node in iter_own_nodes(fi.node):
+            if isinstance(node, ast.Name) and node.id == sub.name \
+                    and id(node) not in covered:
+                return out | set(sub.all_params())  # raw escape
+        return out
+
+    def _intra_taint(self, fi: FuncInfo, seeded: Set[str]) -> Set[str]:
+        """Forward taint propagation over the function's own statements
+        (nested defs excluded), iterated to a small fixpoint so loops
+        converge."""
+        tainted = set(seeded)
+        for _ in range(10):
+            grew = False
+            for node in iter_own_nodes(fi.node):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    targets, value = [node.target], node.iter
+                elif isinstance(node, ast.comprehension):
+                    targets, value = [node.target], node.iter
+                if value is None or not self.expr_tainted(value, tainted):
+                    continue
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) \
+                                and n.id not in tainted:
+                            tainted.add(n.id)
+                            grew = True
+            if not grew:
+                break
+        return tainted
+
+    def expr_tainted(self, node: ast.AST, tainted: Set[str]) -> bool:
+        """Does the expression's value derive from a traced value?"""
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value, tainted)
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            if fn in STATIC_CALLS:
+                return False
+            if fn and (fn.startswith(TRACED_PREFIXES) or fn in
+                       ("vmap", "scan", "cond", "while_loop")):
+                return True
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute) \
+                    and self.expr_tainted(node.func.value, tainted):
+                return True  # method call on a traced value
+            return any(self.expr_tainted(a, tainted) for a in args)
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value, tainted)
+        return any(self.expr_tainted(c, tainted)
+                   for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+    # ------------------------------------------------------ logging closure
+
+    DIRECT_LOG_NAMES = {"warn", "warning", "log_swallowed", "error",
+                        "exception", "critical"}
+
+    def _call_logs_directly(self, call: ast.Call) -> bool:
+        fn = dotted(call.func)
+        if fn == "warnings.warn":
+            return True
+        seg = last_segment(fn)
+        # strip private-alias underscores: `_log_swallowed` is the same
+        # sanctioned sink as `log_swallowed`
+        if seg and seg.lstrip("_") in self.DIRECT_LOG_NAMES:
+            return True
+        # print(..., file=<not stdout>) is the stderr logging idiom
+        if fn == "print":
+            return any(kw.arg == "file" for kw in call.keywords)
+        return False
+
+    def logging_functions(self) -> Set[int]:
+        """ids of repo functions that (transitively) emit a log line —
+        the repo-aware half of the swallowed-exception rule."""
+        if self._logging is not None:
+            return self._logging
+        logs: Set[int] = set()
+        for fi in self.functions:
+            for call in iter_own_calls(fi.node):
+                if self._call_logs_directly(call):
+                    logs.add(id(fi))
+                    break
+        changed = True
+        guard = 0
+        while changed and guard < 100:
+            guard += 1
+            changed = False
+            for fi in self.functions:
+                if id(fi) in logs:
+                    continue
+                for call in iter_own_calls(fi.node):
+                    if any(id(c) in logs for c in self.resolve(call)):
+                        logs.add(id(fi))
+                        changed = True
+                        break
+        self._logging = logs
+        return logs
+
+    def call_is_logging(self, call: ast.Call) -> bool:
+        if self._call_logs_directly(call):
+            return True
+        return any(id(c) in self.logging_functions()
+                   for c in self.resolve(call))
+
+
+# --------------------------------------------------------- tree iteration
+
+def free_names(func_node: ast.AST) -> Set[str]:
+    """Names referenced anywhere in a function (locals included — used
+    to intersect enclosing taint into a closure, where over-approximation
+    is safe)."""
+    return {n.id for n in ast.walk(func_node) if isinstance(n, ast.Name)}
+
+
+def iter_own_nodes(func_node: ast.AST):
+    """Every node of a function body, *excluding* nested function/class
+    bodies (those are separate analysis units)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_own_calls(func_node: ast.AST):
+    for node in iter_own_nodes(func_node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def map_call_args(call: ast.Call,
+                  callee: FuncInfo) -> Dict[str, ast.AST]:
+    """Map a call's arguments onto the callee's parameter names
+    (``self`` dropped for attribute calls). Starred arguments make the
+    positional mapping ambiguous — only keyword args are mapped then."""
+    drop_self = isinstance(call.func, ast.Attribute) \
+        and callee.params()[:1] in (["self"], ["cls"])
+    pos = callee.params(drop_self=drop_self)
+    mapped: Dict[str, ast.AST] = {}
+    starred = any(isinstance(a, ast.Starred) for a in call.args)
+    if not starred:
+        for name, arg in zip(pos, call.args):
+            mapped[name] = arg
+    valid = set(pos) | set(callee.kwonly_params())
+    for kw in call.keywords:
+        if kw.arg is not None and kw.arg in valid:
+            mapped[kw.arg] = kw.value
+    return mapped

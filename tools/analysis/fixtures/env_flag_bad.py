@@ -1,0 +1,16 @@
+"""Seeded env-flag-registry violations (graftlint selftest fixture)."""
+import os
+
+from racon_tpu import flags
+
+
+def bad_direct():
+    return os.environ.get("RACON_TPU_FIXTURE_DIRECT", "")   # VIOLATION
+
+
+def bad_subscript():
+    return os.environ["RACON_TPU_FIXTURE_SUB"]              # VIOLATION
+
+
+def bad_undeclared():
+    return flags.get_bool("RACON_TPU_FIXTURE_UNDECLARED")   # VIOLATION

@@ -1,0 +1,16 @@
+"""Clean twin of env_flag_bad.py — zero findings expected."""
+import os
+
+from racon_tpu import flags
+
+
+def ok_registry():
+    return flags.get_bool("RACON_TPU_SWAR")     # ok: declared flag
+
+
+def ok_other_namespace():
+    return os.environ.get("XLA_FLAGS", "")      # ok: not RACON_TPU_*
+
+
+def ok_write(value):
+    os.environ["RACON_TPU_SWAR"] = value        # ok: writes (test toggles)

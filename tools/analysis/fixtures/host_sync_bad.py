@@ -1,0 +1,27 @@
+"""Seeded host-sync-in-hot-loop violations (graftlint selftest
+fixture). Pretends to live in racon_tpu/ — the selftest runs unscoped."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def hot_loop(chunks):
+    outs = []
+    for c in chunks:
+        out = kernel(c)
+        outs.append(np.asarray(out))        # VIOLATION: pull per chunk
+        out.block_until_ready()             # VIOLATION: sync per chunk
+        s = int(out)                        # VIOLATION: hidden sync
+    return outs, s
+
+
+def hot_loop2(chunks):
+    res = []
+    for c in chunks:
+        res.append(jax.device_get(c))       # VIOLATION: per-item fetch
+    return res

@@ -1,0 +1,27 @@
+"""Clean twin of host_sync_bad.py — zero findings expected."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def kernel(x):
+    return x + 1
+
+
+def fetch_global(tree):
+    return jax.device_get(tree)             # ok: sanctioned primitive
+
+
+def pipelined(chunks):
+    inflight = [kernel(c) for c in chunks]
+    outs = []
+    for out in inflight:
+        host = fetch_global([out])          # ok: one sanctioned fetch
+        outs.append(np.asarray(host[0]))    # ok: already host-side
+    return outs
+
+
+def outside_loop(c):
+    out = kernel(c)
+    return np.asarray(out)                  # ok: not inside a loop

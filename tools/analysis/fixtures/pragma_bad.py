@@ -1,0 +1,3 @@
+"""Pragma-hygiene fixture: unknown rule names are findings."""
+
+x = 1  # graftlint: disable=not-a-rule (typo'd pragma suppresses nothing)

@@ -1,0 +1,32 @@
+"""Seeded swallowed-exception violations (graftlint selftest fixture)."""
+
+
+def swallow_pass():
+    try:
+        risky()
+    except Exception:               # VIOLATION: silent pass
+        pass
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:                         # VIOLATION: bare except, silent return
+        return None
+
+
+def pragma_without_reason():
+    try:
+        risky()
+    except Exception:  # graftlint: disable=swallowed-exception
+        pass            # VIOLATION: pragma must carry a (reason)
+
+
+def swallow_behind_dead_callback():
+    import warnings
+
+    try:
+        risky()
+    except Exception as e:          # VIOLATION: log lives in a nested
+        def report():               # def that is never called
+            warnings.warn(str(e))

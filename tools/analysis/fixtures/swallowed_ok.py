@@ -1,0 +1,43 @@
+"""Clean twin of swallowed_bad.py — zero reported findings expected
+(one finding is pragma-suppressed)."""
+import sys
+import warnings
+
+
+def reraise():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def logs_directly():
+    try:
+        risky()
+    except Exception as e:
+        warnings.warn(f"swallowed: {e}")
+
+
+def my_logger(msg):
+    print(msg, file=sys.stderr)
+
+
+def logs_transitively():
+    try:
+        risky()
+    except Exception as e:
+        my_logger(str(e))
+
+
+def narrow():
+    try:
+        risky()
+    except ValueError:              # ok: narrow handler, out of scope
+        return None
+
+
+def pragma_with_reason():
+    try:
+        risky()
+    except Exception:  # graftlint: disable=swallowed-exception (fixture demo)
+        pass

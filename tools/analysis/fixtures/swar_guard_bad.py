@@ -1,0 +1,22 @@
+"""Seeded swar-guard violations (graftlint selftest fixture)."""
+
+
+def kern(x, *, swar=False):
+    return x
+
+
+def kern2(x, use_swar=False):
+    return x
+
+
+def caller_literal(x):
+    return kern(x, swar=True)       # VIOLATION: unguarded literal on
+
+
+def caller_unguarded(x, want):
+    use = bool(want)                # not derived from swar_fits/swar_ok
+    return kern(x, swar=use)        # VIOLATION
+
+
+def caller_positional(x):
+    return kern2(x, True)           # VIOLATION: positional literal on

@@ -1,0 +1,31 @@
+"""Clean twin of swar_guard_bad.py — zero findings expected."""
+
+
+def swar_fits(n):
+    return n < 16384
+
+
+def kern(x, *, use_swar=False):
+    return x
+
+
+def forward(x, use_swar=False):
+    return kern(x, use_swar=use_swar)   # ok: conventional forwarding
+
+
+def caller(x, n):
+    sw = swar_fits(n)
+    return kern(x, use_swar=sw)         # ok: guard-derived
+
+def caller_chained(x, n, want):
+    sw = want and swar_fits(n)
+    sw2 = sw and n % 2 == 0
+    return kern(x, use_swar=sw2)        # ok: guard-derived through sw
+
+
+def caller_off(x):
+    return kern(x, use_swar=False)      # ok: literal off-switch
+
+def caller_pragma(x):
+    # graftlint: disable=swar-guard (fixture: geometry fits by construction)
+    return kern(x, use_swar=True)

@@ -1,0 +1,31 @@
+"""Seeded tracer-leak violations (graftlint selftest fixture — parsed,
+never imported)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel(x, y, *, n):
+    if x > 0:                       # VIOLATION: python if on a tracer
+        y = y + 1
+    k = int(x)                      # VIOLATION: int() on a tracer
+    z = x + y
+    while z.sum() > 0:              # VIOLATION: while on a derived tracer
+        z = z - 1
+    v = x.item()                    # VIOLATION: .item() on a tracer
+    for i in range(n):              # ok: n is static
+        z = z + i
+    return z, k, v
+
+
+def helper(a, b):
+    if a > b:                       # VIOLATION: reached from kernel2
+        return a
+    return b
+
+
+@jax.jit
+def kernel2(x):
+    return helper(x, x + 1)

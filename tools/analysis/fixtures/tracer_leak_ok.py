@@ -1,0 +1,24 @@
+"""Clean twin of tracer_leak_bad.py — zero findings expected."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("n", "flag"))
+def kernel(x, *, n, flag):
+    if flag:                        # ok: static argument
+        x = x + 1
+    y = jnp.where(x > 0, x, -x)     # ok: traced select, no python branch
+    B = x.shape[0]                  # ok: shape reads are static
+    for i in range(n):              # ok: static trip count
+        y = y + i
+    if B > 4:                       # ok: branching on a static shape
+        y = y * 2
+    return y
+
+
+def host(paths):
+    if len(paths) > 0:              # ok: host-only, not jit-reachable
+        return int(paths[0])
+    return 0

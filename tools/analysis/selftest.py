@@ -1,0 +1,60 @@
+"""Fixture-based self-tests for every graftlint rule.
+
+Each rule runs (unscoped) over a seeded-violation fixture and its clean
+twin; the expected finding counts are exact, so a rule that goes blind
+(0 findings on the bad fixture) or noisy (findings on the clean twin)
+fails the lint shard before the repo-wide run. Run via
+``python -m tools.analysis --selftest`` (CI) or tests/test_graftlint.py.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+# fixture -> (rule name, expected reported, expected pragma-suppressed)
+EXPECT = {
+    "tracer_leak_bad.py": ("tracer-leak", 5, 0),
+    "tracer_leak_ok.py": ("tracer-leak", 0, 0),
+    "swar_guard_bad.py": ("swar-guard", 3, 0),
+    "swar_guard_ok.py": ("swar-guard", 0, 1),
+    "swallowed_bad.py": ("swallowed-exception", 4, 0),
+    "swallowed_ok.py": ("swallowed-exception", 0, 1),
+    "env_flag_bad.py": ("env-flag-registry", 3, 0),
+    "env_flag_ok.py": ("env-flag-registry", 0, 0),
+    "host_sync_bad.py": ("host-sync-in-hot-loop", 4, 0),
+    "host_sync_ok.py": ("host-sync-in-hot-loop", 0, 0),
+    # pragma hygiene is driver-level: unknown rule names are findings
+    "pragma_bad.py": ("pragma", 1, 0),
+}
+
+
+def run_selftest(verbose: bool = True) -> int:
+    from . import run
+    from .rules import RULES_BY_NAME
+
+    failures = []
+    for fixture, (rule_name, want, want_sup) in sorted(EXPECT.items()):
+        path = FIXTURES / fixture
+        rules = ([RULES_BY_NAME[rule_name]]
+                 if rule_name in RULES_BY_NAME else [])
+        reported, suppressed = run([str(path)], rules=rules, scoped=False)
+        reported = [f for f in reported if f.rule == rule_name]
+        if len(reported) != want or len(suppressed) != want_sup:
+            failures.append(
+                f"{fixture}: rule {rule_name} reported "
+                f"{len(reported)} (want {want}), suppressed "
+                f"{len(suppressed)} (want {want_sup}):\n"
+                + "\n".join(f"    {f}" for f in reported))
+        elif verbose:
+            print(f"selftest ok: {fixture} [{rule_name}] "
+                  f"{want} reported / {want_sup} suppressed")
+    if failures:
+        print("graftlint selftest FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print(f"graftlint selftest: {len(EXPECT)} fixtures ok")
+    return 0
