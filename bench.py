@@ -387,6 +387,10 @@ def bench_pipeline():
         for eng in (p.aligner, p.consensus):
             for k, v in getattr(eng, "stats", {}).items():
                 stats[k] = stats.get(k, 0) + v
+        # per-phase jit-compile churn (PhaseRetraceBudget records deltas
+        # whether or not the sanitizer is armed — ROADMAP r8 follow-up)
+        from racon_tpu.sanitize import PhaseRetraceBudget
+        retrace = dict(PhaseRetraceBudget.last_deltas)
         # quality gate on a truth-prefix slice (coordinates drift with
         # indels, so compare a bounded prefix with the full Myers NW)
         probe = min(100_000, len(truths[0]))
@@ -398,8 +402,9 @@ def bench_pipeline():
                                           truths[0][:probe])
         return dict(gen_s=gen_s, init_s=init_s, polish_s=polish_s,
                     total_s=total_s, stats=stats, timings=dict(p.timings),
-                    err_after=err_after, err_before=err_before,
-                    probe=probe, n_polished=len(polished), pol0=pol0)
+                    retrace=retrace, err_after=err_after,
+                    err_before=err_before, probe=probe,
+                    n_polished=len(polished), pol0=pol0)
 
     log(f"pipeline bench: {mbp} Mbp TPU full pipeline...")
     tpu = run_once(mbp, seed=23, backend="tpu", batches=4)
@@ -441,9 +446,12 @@ def bench_pipeline():
         "pipeline_init_s": round(tpu["init_s"], 2),
         "pipeline_polish_s": round(tpu["polish_s"], 2),
         # init-phase attribution (parse_s, align_s, bp_decode_s,
-        # build_windows_s, pipeline_overlap_saved_s) so BENCH rounds can
-        # pin future init regressions to a phase
+        # layer_append_s, build_windows_s, pipeline_overlap_saved_s) so
+        # BENCH rounds can pin future init regressions to a phase — the
+        # layer_append_s entry is the slice-and-append cost the "move
+        # layer storage columnar" ROADMAP call will be decided from
         "pipeline_init_breakdown": tpu["timings"],
+        "pipeline_retrace": tpu["retrace"],
         "pipeline_mbp_per_sec": round(tput, 4),
         **fused_metrics,
         "pipeline_cpu_mbp": cpu_mbp,
@@ -454,6 +462,134 @@ def bench_pipeline():
         "pipeline_err_per_100k_after": tpu["err_after"],
         "pipeline_stats": tpu["stats"],
     }
+
+
+def bench_shards():
+    """Streaming shard-runner scaling entry (the ROADMAP ">=100 Mbp
+    demonstration"): run a RACON_TPU_BENCH_SHARDS-sized (default 100)
+    Mbp simulated assembly through ``racon_tpu.exec.ShardRunner`` under
+    a --max-ram-style budget and record the scaling curve — Mbp/s per
+    shard, init/polish breakdown, retrace counters, peak RSS vs budget —
+    plus a 1 Mbp CPU-engine baseline. A smaller invariance probe first
+    asserts ``--shards 4`` output is byte-identical to the single-shot
+    FASTA (the subsystem's concluding contract). 0 disables."""
+    import io
+    import os
+    import subprocess
+    import tempfile
+
+    from racon_tpu import flags as racon_flags
+
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_SHARDS")
+    if not mbp:
+        return {}
+    from racon_tpu.core.polisher import create_polisher
+    from racon_tpu.exec import ShardRunner
+    from racon_tpu.exec.heartbeat import peak_rss_bytes
+
+    sim_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "simulate.py")
+
+    def gen(mbp_run, seed, td):
+        # throwaway subprocess: a 100 Mbp set materializes several GB
+        # while generating, which must not land in THIS process's
+        # ru_maxrss — that is the number the budget check reports on
+        subprocess.run([sys.executable, sim_py, str(mbp_run), td,
+                        "--seed", str(seed)], check=True)
+        return {k: os.path.join(td, v) for k, v in
+                (("reads", "reads.fastq"), ("overlaps", "ovl.paf"),
+                 ("draft", "draft.fasta"))}
+
+    def run_sharded(paths, work, **kw):
+        runner = ShardRunner(
+            paths["reads"], paths["overlaps"], paths["draft"],
+            num_threads=8, aligner_backend="tpu", consensus_backend="tpu",
+            aligner_batches=4, consensus_batches=4, work_dir=work,
+            keep_work_dir=False, **kw)
+        buf = io.BytesIO()
+        summary = runner.run(buf)
+        return buf.getvalue(), summary
+
+    def run_single(paths, backend="tpu", batches=4):
+        p = create_polisher(
+            paths["reads"], paths["overlaps"], paths["draft"],
+            num_threads=8, aligner_backend=backend,
+            consensus_backend=backend, aligner_batches=batches,
+            consensus_batches=batches)
+        polished = p.run(True)
+        return b"".join(b">" + s.name + b"\n" + s.data + b"\n"
+                        for s in polished)
+
+    out = {}
+    inv_mbp = min(4.0, mbp)
+    with tempfile.TemporaryDirectory() as td:
+        gen_paths = gen(inv_mbp, 41, td)
+        log(f"shard bench: invariance probe at {inv_mbp} Mbp "
+            f"(single-shot vs --shards 4)...")
+        t0 = time.perf_counter()
+        want = run_single(gen_paths)
+        single_s = time.perf_counter() - t0
+        got, _ = run_sharded(gen_paths, os.path.join(td, "work"),
+                             n_shards=4)
+        assert got == want, \
+            "--shards 4 output diverged from the single-shot FASTA"
+        log(f"shard bench: invariance OK (single-shot {single_s:.1f}s)")
+        out.update(shard_invariance_mbp=inv_mbp,
+                   shard_invariance="byte-identical")
+
+    with tempfile.TemporaryDirectory() as td:
+        log(f"shard bench: generating {mbp} Mbp workload (subprocess)...")
+        gen_paths = gen(mbp, 43, td)
+        data_bytes = sum(os.path.getsize(p) for p in gen_paths.values())
+        base = peak_rss_bytes()
+        budget = base + max(int(0.6 * data_bytes), 2 << 30)
+        log(f"shard bench: {mbp} Mbp streaming run, --max-ram "
+            f"{budget >> 20} MB (base RSS {base >> 20} MB)...")
+        t0 = time.perf_counter()
+        blob, summary = run_sharded(gen_paths, os.path.join(td, "work"),
+                                    max_ram_bytes=budget)
+        wall = time.perf_counter() - t0
+        peak = peak_rss_bytes()
+        log(f"shard bench: {summary['n_shards']} shards in {wall:.1f}s "
+            f"({mbp / wall:.4f} Mbp/s), peak RSS {peak >> 20} MB "
+            f"(budget {budget >> 20} MB), "
+            f"{len(blob) / 1e6:.0f} MB polished FASTA")
+        assert blob.count(b">") > 0
+        curve = [{
+            "shard": e["id"], "status": e["status"],
+            "engine": e.get("engine"), "mbp": e.get("mbp"),
+            "wall_s": e.get("wall_s"),
+            "mbp_per_sec": (round(e["mbp"] / e["wall_s"], 4)
+                            if e.get("wall_s") else None),
+            "init_breakdown": e.get("timings"),
+            "retrace": e.get("retrace"),
+            "peak_rss_mb": e.get("peak_rss_mb"),
+        } for e in summary["shards"]]
+        out.update(
+            shard_mbp=mbp, shard_count=summary["n_shards"],
+            shard_total_s=round(wall, 2),
+            shard_mbp_per_sec=round(mbp / wall, 4),
+            shard_peak_rss_mb=peak >> 20,
+            shard_budget_mb=budget >> 20,
+            shard_under_budget=bool(peak <= budget),
+            shard_curve=curve,
+            shard_quarantined=summary["quarantined"])
+
+    with tempfile.TemporaryDirectory() as td:
+        cpu_mbp = min(1.0, mbp)
+        gen_paths = gen(cpu_mbp, 47, td)
+        log(f"shard bench: {cpu_mbp} Mbp CPU-engine baseline...")
+        t0 = time.perf_counter()
+        run_single(gen_paths, backend="cpu", batches=1)
+        cpu_s = time.perf_counter() - t0
+        log(f"shard bench: cpu {cpu_s:.1f}s "
+            f"({cpu_mbp / cpu_s:.4f} Mbp/s)")
+        out.update(
+            shard_cpu_mbp=cpu_mbp,
+            shard_cpu_mbp_per_sec=round(cpu_mbp / cpu_s, 4),
+            shard_vs_cpu=round(out["shard_mbp_per_sec"]
+                               / (cpu_mbp / cpu_s), 3))
+    return out
 
 
 def bench_parse():
@@ -508,6 +644,7 @@ def main():
     aligner_metrics = bench_aligner()
     scale_metrics = bench_scale()
     pipeline_metrics = bench_pipeline()
+    shard_metrics = bench_shards()
     parse_metrics = bench_parse()
 
     total_bases = sum(len(w.sequences[0]) for w in windows)
@@ -525,6 +662,7 @@ def main():
         **aligner_metrics,
         **scale_metrics,  # scale_mbp_per_sec + consensus_vpu_util_est
         **pipeline_metrics,  # full-pipeline Mbp/s + CPU baseline
+        **shard_metrics,  # streaming shard-runner scaling curve
         **parse_metrics,
         "device": str(jax.devices()[0]),
     }

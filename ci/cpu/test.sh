@@ -27,8 +27,15 @@ RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
 # decoder, and the pipelined run() — including the num_threads=1
 # sequential-fallback smoke — before anything slow runs
 python -m pytest tests/test_columnar_init.py tests/test_window.py -q
+# streaming shard-run smoke (fail-fast): graftlint-clean gate over the
+# new racon_tpu/exec package, then the invariance suite — including the
+# 2-shard/3-shard byte-identity checks and the SIGKILL-then---resume
+# round trip — before anything slow runs
+python -m tools.analysis --quiet racon_tpu/exec
+python -m pytest tests/test_exec.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
-  --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py
+  --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
+  --ignore=tests/test_exec.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

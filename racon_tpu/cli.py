@@ -66,6 +66,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of the polishing run "
                         "to DIR (view with TensorBoard / xprof; the TPU "
                         "analog of the reference's nvprof hooks)")
+    # streaming shard runner (racon_tpu.exec): bounded-memory runs with
+    # checkpoint/resume; output stays byte-identical to a single-shot run
+    p.add_argument("--shards", type=int, default=0, metavar="N",
+                   help="polish through the streaming shard runner with "
+                        "N memory-bounded shards of target contigs")
+    p.add_argument("--max-ram", default=None, metavar="SIZE",
+                   help="shard the run to keep peak RSS under SIZE "
+                        "(plain number = MB; K/M/G/T suffixes accepted); "
+                        "implies the streaming shard runner")
+    p.add_argument("--resume", action="store_true",
+                   help="resume an interrupted shard run: completed "
+                        "shards are skipped via the checkpoint manifest, "
+                        "only the interrupted one re-runs")
+    p.add_argument("--shard-dir", default=None, metavar="DIR",
+                   help="work directory for shard inputs, part files and "
+                        "the checkpoint manifest (default: a directory "
+                        "derived from the input paths and parameters, "
+                        "removed after a fully successful run; an "
+                        "explicit DIR is kept)")
     return p
 
 
@@ -91,10 +110,44 @@ def _preprocess_argv(argv):
     return out
 
 
+def _run_sharded(args) -> int:
+    """Route through the streaming shard runner (racon_tpu.exec)."""
+    from .exec import ShardRunner, parse_ram
+
+    try:
+        runner = ShardRunner(
+            args.sequences, args.overlaps, args.target_sequences,
+            type_=PolisherType.F if args.fragment_correction
+            else PolisherType.C,
+            window_length=args.window_length,
+            quality_threshold=args.quality_threshold,
+            error_threshold=args.error_threshold,
+            trim=not args.no_trimming,
+            match=args.match, mismatch=args.mismatch, gap=args.gap,
+            num_threads=args.threads,
+            aligner_backend="tpu" if args.tpualigner_batches > 0 else "auto",
+            consensus_backend="tpu" if args.tpupoa_batches > 0 else "auto",
+            aligner_batches=max(1, args.tpualigner_batches),
+            consensus_batches=max(1, args.tpupoa_batches),
+            banded=args.tpu_banded_alignment,
+            include_unpolished=args.include_unpolished,
+            n_shards=args.shards,
+            max_ram_bytes=parse_ram(args.max_ram) if args.max_ram else 0,
+            resume=args.resume, work_dir=args.shard_dir)
+        runner.run(sys.stdout.buffer)
+    except (ValueError, RuntimeError, OSError) as e:
+        print(f"[racon::] error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     args = build_parser().parse_args(_preprocess_argv(list(argv)))
+
+    if args.shards or args.max_ram or args.resume or args.shard_dir:
+        return _run_sharded(args)
 
     try:
         polisher = create_polisher(

@@ -69,13 +69,27 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     num_threads: int = 1, aligner_backend: str = "auto",
                     consensus_backend: str = "auto", aligner_batches: int = 1,
                     consensus_batches: int = 1,
-                    banded: bool = False) -> "Polisher":
+                    banded: bool = False, *, aligner=None, consensus=None,
+                    window_type=None, prefiltered_overlaps: bool = False,
+                    evict_reads: bool = False) -> "Polisher":
     """Factory with the reference's validation rules
     (``polisher.cpp:62-133``). ``aligner_batches``/``consensus_batches``
     are the accelerator batch counts (reference ``-c N`` /
     ``--cudaaligner-batches N``, ``cudapolisher.cpp:91,215-228``) — here
     the device pipeline depth, with the memory budget split per batch;
-    ``banded`` is the reference's ``-b`` POA banding approximation."""
+    ``banded`` is the reference's ``-b`` POA banding approximation.
+
+    The keyword-only tail is the streaming shard runner's per-shard
+    reuse surface (``racon_tpu.exec``): ``aligner``/``consensus`` inject
+    prebuilt engines (jit caches and warm-up compiles survive across
+    shards), ``window_type`` pins the NGS/TGS heuristic to the
+    whole-input decision (a shard's read subset must not flip it),
+    ``prefiltered_overlaps`` marks the overlap stream as already
+    globally filtered (the runner's index pass applied the
+    best-per-query-group rule over the FULL file — re-running it on a
+    shard's subsequence could merge groups split in the original
+    stream), and ``evict_reads`` releases read payloads the moment
+    their window layers are assembled."""
     if not isinstance(type_, PolisherType):
         raise ValueError("invalid polisher type")
     if window_length <= 0:
@@ -93,7 +107,10 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     window_length, quality_threshold, error_threshold, trim,
                     match, mismatch, gap, num_threads, aligner_backend,
                     consensus_backend, aligner_batches, consensus_batches,
-                    banded)
+                    banded, aligner=aligner, consensus=consensus,
+                    window_type=window_type,
+                    prefiltered_overlaps=prefiltered_overlaps,
+                    evict_reads=evict_reads)
 
 
 class Polisher:
@@ -101,7 +118,9 @@ class Polisher:
                  window_length, quality_threshold, error_threshold, trim,
                  match, mismatch, gap, num_threads,
                  aligner_backend="auto", consensus_backend="auto",
-                 aligner_batches=1, consensus_batches=1, banded=False):
+                 aligner_batches=1, consensus_batches=1, banded=False,
+                 aligner=None, consensus=None, window_type=None,
+                 prefiltered_overlaps=False, evict_reads=False):
         self.sequences_path = sequences_path
         self.overlaps_path = overlaps_path
         self.target_path = target_path
@@ -112,12 +131,16 @@ class Polisher:
         self.trim = trim
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.num_threads = num_threads
-        self.aligner = make_aligner(aligner_backend, num_threads,
-                                    num_batches=aligner_batches)
-        self.consensus = make_consensus(consensus_backend, match, mismatch,
-                                        gap, num_threads,
-                                        num_batches=consensus_batches,
-                                        banded=banded)
+        self.aligner = aligner if aligner is not None else make_aligner(
+            aligner_backend, num_threads, num_batches=aligner_batches)
+        self.consensus = consensus if consensus is not None else \
+            make_consensus(consensus_backend, match, mismatch, gap,
+                           num_threads, num_batches=consensus_batches,
+                           banded=banded)
+        # shard-run hooks (see create_polisher)
+        self._window_type_override = window_type
+        self.prefiltered_overlaps = prefiltered_overlaps
+        self.evict_reads = evict_reads
         self.logger = Logger()
 
         self.sequences: List[Sequence] = []
@@ -210,6 +233,10 @@ class Polisher:
         self._window_type = (WindowType.NGS
                              if total_len / raw_index <= 1000
                              else WindowType.TGS)
+        if self._window_type_override is not None:
+            # shard runs pin the heuristic to the whole-input decision:
+            # a shard's read subset must not flip NGS/TGS mid-assembly
+            self._window_type = self._window_type_override
 
         log.log("[racon_tpu::Polisher::initialize] loaded sequences")
         log.log()
@@ -222,7 +249,8 @@ class Polisher:
             if o.is_valid:
                 overlaps.append(o)
 
-        overlaps = self._filter_overlaps(overlaps)
+        if not self.prefiltered_overlaps:
+            overlaps = self._filter_overlaps(overlaps)
         if not overlaps:
             raise ValueError("empty overlap set")
 
@@ -447,6 +475,7 @@ class Polisher:
         if total_pairs == 0:
             if emit is not None:
                 emit(0, n_win)
+            self.timings["layer_append_s"] = 0.0
             self.timings["build_windows_s"] = round(
                 self._backbone_s + (time.perf_counter() - t_build), 3)
             return
@@ -539,9 +568,16 @@ class Polisher:
         windows = self.windows
         if not chunk_windows:
             chunk_windows = n_win
+        # the slice-and-append loop below is the last Python-bound init
+        # cost (~1 µs/layer); it is timed separately (CPU time — the
+        # pipelined producer's wall-clock stretches under GIL sharing)
+        # so BENCH rounds can decide the "move layer storage columnar"
+        # ROADMAP call from shard-scale data
+        t_append = 0.0
         for w0 in range(0, n_win, chunk_windows):
             w1 = min(w0 + chunk_windows, n_win)
             p0, p1 = (int(x) for x in np.searchsorted(sorted_win, [w0, w1]))
+            t_slice = time.thread_time()
             for wi, ov, qb, qe, lb, le in zip(
                     wi_l[p0:p1], ov_l[p0:p1], qb_l[p0:p1], qe_l[p0:p1],
                     b_l[p0:p1], e_l[p0:p1]):
@@ -551,11 +587,19 @@ class Polisher:
                 win.qualities.append(qual[qb:qe]
                                      if qual is not None else None)
                 win.positions.append((lb, le))
+            t_append += time.thread_time() - t_slice
             if emit is not None:
                 emit(w0, w1)
+        self.timings["layer_append_s"] = round(t_append, 3)
 
         for o in overlaps:
             o.breaking_points = None
+        if self.evict_reads:
+            # every layer above holds a *copy* of its span, so the read
+            # pool (data + revcomp + qualities) is dead weight from here
+            # on — the shard runner's memory budget counts on this
+            for seq in self.sequences[self.targets_size:]:
+                seq.release()
         self.timings["build_windows_s"] = round(
             self._backbone_s + (time.perf_counter() - t_build), 3)
 

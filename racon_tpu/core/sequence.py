@@ -66,6 +66,19 @@ class Sequence:
         self._reverse_quality = (self.quality[::-1]
                                  if self.quality is not None else None)
 
+    def release(self) -> None:
+        """Drop every byte payload (data, quality, materialized reverse
+        complement), keeping only the name. Eviction hook for the
+        streaming shard runner (``racon_tpu.exec``): once a read's window
+        layers are assembled (the layers hold *copies* of the spans), the
+        read's bytes are dead weight for the rest of the shard — on
+        100 Mbp+ runs the resident read pool is the dominant term of the
+        ``--max-ram`` budget."""
+        self.data = b""
+        self.quality = None
+        self._reverse_complement = None
+        self._reverse_quality = None
+
     def transmute(self, has_name: bool, has_data: bool, has_reverse_data: bool) -> None:
         if not has_name:
             self.name = b""

@@ -1,0 +1,35 @@
+"""Streaming shard-run subsystem: bounded-memory polishing at genome scale.
+
+Racon's whole purpose is polishing Gbp-sized assemblies (the reference
+ships the ``rampler`` split wrapper precisely for that), but a single
+:class:`~racon_tpu.core.polisher.Polisher` materializes every sequence,
+overlap and window at once. This package makes arbitrarily large runs
+feasible and survivable:
+
+- :mod:`.index` — one cheap metadata pass over the inputs (names + byte
+  spans, no payloads) that also applies the polisher's GLOBAL overlap
+  filter, so per-shard runs see exactly the overlap set a single-shot run
+  would keep (the shard-count-invariance contract);
+- :mod:`.planner` — partitions target contigs into memory-budgeted
+  shards (``--max-ram``/``--shards``/byte-size) with an LPT bin-pack over
+  a resident-footprint cost model;
+- :mod:`.runner` — streams each shard through the existing
+  ``Polisher.run()`` init->polish pipeline (engines reused across shards,
+  consumed reads evicted), emits atomic per-shard part files, retries a
+  failed shard once on the CPU engines and quarantines it with a logged
+  reason instead of killing the run, then merges parts back into
+  target-file order on stdout;
+- :mod:`.manifest` — the fsync'd JSON checkpoint that makes ``--resume``
+  skip completed shards and re-run only the interrupted one;
+- :mod:`.heartbeat` — the long-run progress line (shard i/N, Mbp/s, peak
+  RSS, jit-retrace counters).
+
+The concluding contract, asserted in ``tests/test_exec.py`` and
+``bench.py``: multi-shard and kill-then-resume runs are byte-identical to
+the single-shot FASTA.
+"""
+
+from .index import RunIndex, build_index  # noqa: F401
+from .manifest import load_manifest, save_manifest  # noqa: F401
+from .planner import ShardPlan, parse_ram, plan_shards  # noqa: F401
+from .runner import ShardRunner  # noqa: F401

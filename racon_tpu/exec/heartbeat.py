@@ -1,0 +1,93 @@
+"""Long-run progress heartbeat: shard i/N, Mbp/s, peak RSS, jit-retrace
+counters.
+
+A 100 Mbp+ polish runs for hours; the per-stage progress bars only show
+the *current* shard. The heartbeat thread prints one self-contained line
+every ``RACON_TPU_HEARTBEAT_S`` seconds (0 disables the periodic timer),
+and the runner also emits one at every shard completion, so logs from
+killed runs always end with an accurate position. Retrace counters come
+from :class:`racon_tpu.sanitize.PhaseRetraceBudget`, which records
+per-phase jit-compile deltas whether or not the sanitizer is armed — a
+shard that suddenly recompiles per chunk shows up here long before it
+shows up in wall-clock.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional
+
+from .. import flags, sanitize
+
+
+def peak_rss_bytes() -> int:
+    """Lifetime peak RSS of this process (ru_maxrss is KiB on Linux,
+    bytes on macOS)."""
+    import resource
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return rss if sys.platform == "darwin" else rss * 1024
+
+
+def retrace_summary() -> str:
+    deltas = sanitize.PhaseRetraceBudget.last_deltas
+    if not deltas:
+        return "-"
+    return ",".join(f"{k}={v}" for k, v in sorted(deltas.items()))
+
+
+class Heartbeat:
+    """Shared-state progress reporter for the shard runner."""
+
+    def __init__(self, n_shards: int, stream=None):
+        self.n_shards = n_shards
+        self._stream = stream if stream is not None else sys.stderr
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._done = 0
+        self._mbp = 0.0
+        self._phase = "indexing"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Heartbeat":
+        interval = flags.get_float("RACON_TPU_HEARTBEAT_S")
+        if interval > 0:
+            self._thread = threading.Thread(
+                target=self._tick, args=(interval,),
+                name="racon-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+
+    def update(self, done: Optional[int] = None,
+               mbp: Optional[float] = None,
+               phase: Optional[str] = None) -> None:
+        with self._lock:
+            if done is not None:
+                self._done = done
+            if mbp is not None:
+                self._mbp = mbp
+            if phase is not None:
+                self._phase = phase
+
+    def emit(self, tag: str = "heartbeat") -> None:
+        with self._lock:
+            done, mbp, phase = self._done, self._mbp, self._phase
+        dt = max(1e-9, time.perf_counter() - self._t0)
+        print(f"[racon_tpu::exec] {tag}: shard {done}/{self.n_shards} "
+              f"({phase}) {mbp:.2f} Mbp in {dt:.1f}s "
+              f"({mbp / dt:.4f} Mbp/s) "
+              f"peak_rss={peak_rss_bytes() >> 20}MB "
+              f"retrace[{retrace_summary()}]",
+              file=self._stream)
+        self._stream.flush()
+
+    def _tick(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            self.emit()

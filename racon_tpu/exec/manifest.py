@@ -1,0 +1,101 @@
+"""Checkpoint manifest: the fsync'd JSON record that makes shard runs
+survivable.
+
+Write protocol (crash-ordering matters more than speed here — the
+manifest is written once per shard transition):
+
+1. part files are written to ``<part>.tmp``, fsync'd, then
+   ``os.replace``d into place — a part file either exists complete or
+   not at all;
+2. the manifest is then rewritten the same way (tmp + fsync + atomic
+   replace + directory fsync), so it never claims a part that a crash
+   could have torn.
+
+``--resume`` trusts a shard exactly when the manifest says ``done`` AND
+the recorded part file exists with the recorded size. A corrupt or
+truncated manifest (the seeded-recovery test truncates one mid-object)
+is treated as absent: the run replans and re-executes every shard —
+correct output always beats salvaged work. A fingerprint of the inputs,
+parameters and the plan itself guards against resuming into a different
+run's directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..utils.logger import warn
+
+MANIFEST_NAME = "manifest.json"
+VERSION = 1
+
+DONE = "done"
+QUARANTINED = "quarantined"
+PENDING = "pending"
+RUNNING = "running"
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename inside it survives power loss."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+
+
+def save_manifest(work_dir: str, manifest: dict) -> None:
+    manifest = dict(manifest, version=VERSION)
+    atomic_write(os.path.join(work_dir, MANIFEST_NAME),
+                 json.dumps(manifest, indent=1).encode())
+
+
+def load_manifest(work_dir: str) -> Optional[dict]:
+    """The stored manifest, or None when absent/corrupt/foreign-version
+    (with the reason on stderr — a resume that silently restarts from
+    zero is surprising)."""
+    path = os.path.join(work_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            manifest = json.loads(f.read())
+        if manifest.get("version") != VERSION:
+            warn(f"manifest {path} has version "
+                 f"{manifest.get('version')!r} (want {VERSION}) — "
+                 f"ignoring it and re-running every shard")
+            return None
+        manifest["shards"]  # required keys probe
+        manifest["fingerprint"]
+        return manifest
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        warn(f"manifest {path} is corrupt ({type(e).__name__}: {e}) — "
+             f"ignoring it and re-running every shard")
+        return None
+
+
+def input_fingerprint(paths, params: dict) -> dict:
+    """Identity of a run: absolute input paths + sizes plus every
+    parameter that shapes output *bytes*. Sizing knobs
+    (``--shards``/``--max-ram``) and the plan itself are deliberately
+    NOT part of the match: shard boundaries never change the merged
+    output (the invariance contract), a ``--max-ram`` plan depends on
+    the planning process's live RSS, and a user typing a bare
+    ``racon --resume`` must not lose hours of checkpointed work for
+    omitting the original sizing flags — the resume path *adopts* the
+    plan stored in the manifest instead."""
+    files = [{"path": os.path.abspath(p), "size": os.path.getsize(p)}
+             for p in paths]
+    return {"files": files, "params": params}

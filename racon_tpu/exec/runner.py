@@ -1,0 +1,495 @@
+"""ShardRunner: stream a polishing run shard-by-shard with checkpoints.
+
+Per shard: extract the shard's inputs from the original files by byte
+range (targets verbatim, the globally-filtered overlap lines verbatim —
+MHAP ids rewritten to shard-local ordinals — and exactly the reads those
+overlaps reference), run the existing ``Polisher.run()`` init->polish
+pipeline on them (device engines are REUSED across shards so jit caches
+and warm-up compiles pay once; consumed reads are evicted the moment
+their layers are assembled), write the polished FASTA to an atomic part
+file, and record it in the fsync'd manifest. A failed shard (device
+fault, sanitizer trip, OOM-adjacent allocation failure) is retried once
+on the CPU consensus/aligner engines and quarantined with a logged
+reason instead of killing the run. Completed parts are finally merged
+back into target-file order, which makes the output byte-identical to a
+single-shot run — the invariance proof lives in ``tests/test_exec.py``
+and ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import flags
+from ..core.backends import make_aligner, make_consensus
+from ..core.polisher import PolisherType, create_polisher
+from ..io import parsers
+from ..sanitize import PhaseRetraceBudget
+from ..utils.logger import warn
+from . import heartbeat as hb
+from . import manifest as mf
+from .index import RunIndex, build_index
+from .planner import ShardPlan, plan_shards
+
+
+def _eprint(msg: str) -> None:
+    print(f"[racon_tpu::exec] {msg}", file=sys.stderr, flush=True)
+
+
+def _plain_ext(path: str, candidates, default: str) -> str:
+    """Output extension for extracted (always-uncompressed) spans."""
+    base = path[:-3] if path.endswith(".gz") else path
+    for ext in candidates:
+        if not ext.endswith(".gz") and base.endswith(ext):
+            return ext
+    return default
+
+
+def _fault_spec() -> Tuple[Optional[int], bool]:
+    """(shard_id, every_attempt) from RACON_TPU_EXEC_FAULT_SHARD."""
+    v = flags.get_str("RACON_TPU_EXEC_FAULT_SHARD").strip()
+    if not v:
+        return None, False
+    if v.endswith("*"):
+        return int(v[:-1]), True
+    return int(v), False
+
+
+class ShardRunner:
+    """Bounded-memory, checkpointed drive of the polishing pipeline."""
+
+    def __init__(self, sequences: str, overlaps: str, target_sequences: str,
+                 *, type_: PolisherType = PolisherType.C,
+                 window_length: int = 500, quality_threshold: float = 10.0,
+                 error_threshold: float = 0.3, trim: bool = True,
+                 match: int = 3, mismatch: int = -5, gap: int = -4,
+                 num_threads: int = 1, aligner_backend: str = "auto",
+                 consensus_backend: str = "auto", aligner_batches: int = 1,
+                 consensus_batches: int = 1, banded: bool = False,
+                 include_unpolished: bool = False, n_shards: int = 0,
+                 max_ram_bytes: int = 0, max_target_bytes: int = 0,
+                 resume: bool = False, work_dir: Optional[str] = None,
+                 keep_work_dir: Optional[bool] = None):
+        self.sequences = os.path.abspath(sequences)
+        self.overlaps = os.path.abspath(overlaps)
+        self.target_sequences = os.path.abspath(target_sequences)
+        self.type = type_
+        self.window_length = window_length
+        self.quality_threshold = quality_threshold
+        self.error_threshold = error_threshold
+        self.trim = trim
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.num_threads = num_threads
+        self.aligner_backend = aligner_backend
+        self.consensus_backend = consensus_backend
+        self.aligner_batches = aligner_batches
+        self.consensus_batches = consensus_batches
+        self.banded = banded
+        self.include_unpolished = include_unpolished
+        self.n_shards = n_shards
+        self.max_ram_bytes = max_ram_bytes
+        self.max_target_bytes = max_target_bytes
+        self.resume = resume
+        # an explicit work dir is the user's to keep (resume workflows);
+        # a derived one is removed after a fully successful run
+        self.keep_work_dir = (keep_work_dir if keep_work_dir is not None
+                              else work_dir is not None)
+        self.work_dir = os.path.abspath(work_dir or self.derive_work_dir())
+        self.index: Optional[RunIndex] = None
+        self.plan: Optional[ShardPlan] = None
+        self.summary: Dict = {}
+        self._engines = None       # (aligner, consensus) — reused per shard
+        self._cpu_engines = None   # lazy retry pair
+
+    # ------------------------------------------------------------ identity
+
+    def derive_work_dir(self) -> str:
+        """Deterministic default work dir: same inputs + parameters =>
+        same directory, so ``--resume`` needs no extra bookkeeping."""
+        h = hashlib.sha1()
+        for part in (self.sequences, self.overlaps, self.target_sequences,
+                     self.type.name, self.window_length,
+                     self.quality_threshold, self.error_threshold,
+                     self.trim, self.match, self.mismatch, self.gap,
+                     self.include_unpolished):
+            h.update(repr(part).encode())
+        return os.path.join(os.getcwd(),
+                            f"racon_exec_{h.hexdigest()[:12]}")
+
+    def _params_fingerprint(self) -> dict:
+        return {"type": self.type.name,
+                "window_length": self.window_length,
+                "quality_threshold": self.quality_threshold,
+                "error_threshold": self.error_threshold,
+                "trim": self.trim, "match": self.match,
+                "mismatch": self.mismatch, "gap": self.gap,
+                "include_unpolished": self.include_unpolished}
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, out) -> Dict:
+        """Execute (or resume) the full sharded run, writing the merged
+        polished FASTA to the binary stream ``out``. Returns the summary
+        dict (also kept as :attr:`summary`)."""
+        t0 = time.perf_counter()
+        _eprint(f"indexing {os.path.basename(self.overlaps)} / "
+                f"{os.path.basename(self.sequences)}")
+        self.index = build_index(self.sequences, self.overlaps,
+                                 self.target_sequences, self.type,
+                                 self.error_threshold)
+        base_rss = hb.peak_rss_bytes()
+        self.plan = plan_shards(self.index, self.n_shards,
+                                self.max_ram_bytes, self.max_target_bytes,
+                                base_rss=base_rss)
+        os.makedirs(self.work_dir, exist_ok=True)
+        # a valid resume manifest ADOPTS the stored plan (a --max-ram
+        # plan depends on the planning process's live RSS, so this
+        # process could legitimately compute a different one — re-running
+        # completed shards over that would defeat --resume)
+        manifest = self._load_or_init_manifest()
+        n = self.plan.n_shards
+        total_mbp = sum(t.bases for t in self.index.targets) / 1e6
+        _eprint(f"plan: {len(self.index.targets)} contigs "
+                f"({total_mbp:.2f} Mbp), {len(self.index.ov_start)} "
+                f"overlaps -> {n} shards (mode={self.plan.mode})")
+        beat = hb.Heartbeat(n).start()
+        mbp_done = 0.0
+        try:
+            for si, shard in enumerate(self.plan.shards):
+                entry = manifest["shards"][si]
+                shard_mbp = sum(self.index.targets[ci].bases
+                                for ci in shard) / 1e6
+                if self._shard_is_done(entry):
+                    _eprint(f"resume: skipping completed shard {si} "
+                            f"({shard_mbp:.2f} Mbp)")
+                    mbp_done += shard_mbp
+                    beat.update(done=si + 1, mbp=mbp_done, phase="resume")
+                    continue
+                beat.update(done=si, phase="polishing")
+                self._run_shard(si, shard, entry, manifest, beat)
+                if entry["status"] == mf.DONE:
+                    mbp_done += shard_mbp
+                beat.update(done=si + 1, mbp=mbp_done)
+                beat.emit(f"shard {si} {entry['status']} "
+                          f"engine={entry.get('engine', '-')}")
+            beat.update(phase="merging")
+            self._merge_parts(manifest, out)
+        finally:
+            beat.stop()
+
+        quarantined = [e for e in manifest["shards"]
+                       if e["status"] == mf.QUARANTINED]
+        for e in quarantined:
+            warn(f"shard {e['id']} quarantined: {e.get('reason')}")
+        wall = time.perf_counter() - t0
+        self.summary = {
+            "n_shards": n, "mode": self.plan.mode,
+            "mbp_total": round(total_mbp, 4),
+            "mbp_polished": round(mbp_done, 4),
+            "wall_s": round(wall, 2),
+            "mbp_per_sec": round(mbp_done / wall, 4) if wall else 0.0,
+            "peak_rss_bytes": hb.peak_rss_bytes(),
+            "base_rss_bytes": base_rss,
+            "budget_bytes": self.plan.budget_bytes,
+            "quarantined": [e["id"] for e in quarantined],
+            "shards": [dict(e) for e in manifest["shards"]],
+        }
+        if not quarantined and not self.keep_work_dir:
+            shutil.rmtree(self.work_dir, ignore_errors=True)
+        return self.summary
+
+    # ------------------------------------------------------------ manifest
+
+    def _load_or_init_manifest(self) -> dict:
+        fingerprint = mf.input_fingerprint(
+            (self.sequences, self.overlaps, self.target_sequences),
+            self._params_fingerprint())
+        manifest = mf.load_manifest(self.work_dir) if self.resume else None
+        if manifest is not None and manifest["fingerprint"] != fingerprint:
+            warn("manifest fingerprint does not match this run's inputs/"
+                 "parameters — re-running every shard")
+            manifest = None
+        if manifest is not None:
+            stored = [list(map(int, e["contigs"]))
+                      for e in manifest["shards"]]
+            if sorted(ci for s in stored for ci in s) == \
+                    list(range(len(self.index.targets))):
+                self.plan.shards = stored  # the plan the parts were cut by
+            else:
+                warn("manifest shard plan does not cover this input's "
+                     "contigs — re-running every shard")
+                manifest = None
+        if not self.resume:
+            self._clean_work_dir()
+        if manifest is None:
+            manifest = {
+                "fingerprint": fingerprint,
+                "shards": [{"id": si, "contigs": list(map(int, shard)),
+                            "status": mf.PENDING,
+                            "part": f"part_{si:04d}.fasta"}
+                           for si, shard in enumerate(self.plan.shards)],
+            }
+            mf.save_manifest(self.work_dir, manifest)
+        return manifest
+
+    def _clean_work_dir(self) -> None:
+        """Drop recognized artifacts of a previous run (fresh, non-resume
+        runs must not trust stale parts)."""
+        for name in os.listdir(self.work_dir):
+            path = os.path.join(self.work_dir, name)
+            if name == mf.MANIFEST_NAME or name.startswith("part_"):
+                os.unlink(path)
+            elif name.startswith("shard_") and os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    def _shard_is_done(self, entry: dict) -> bool:
+        if entry.get("status") != mf.DONE:
+            return False
+        part = os.path.join(self.work_dir, entry["part"])
+        return (os.path.exists(part)
+                and os.path.getsize(part) == entry.get("bytes", -1))
+
+    # ------------------------------------------------------ shard execution
+
+    def _get_engines(self, cpu: bool):
+        if cpu:
+            if self._cpu_engines is None:
+                self._cpu_engines = (
+                    make_aligner("auto", self.num_threads),
+                    make_consensus("auto", self.match, self.mismatch,
+                                   self.gap, self.num_threads))
+            return self._cpu_engines
+        if self._engines is None:
+            self._engines = (
+                make_aligner(self.aligner_backend, self.num_threads,
+                             num_batches=self.aligner_batches),
+                make_consensus(self.consensus_backend, self.match,
+                               self.mismatch, self.gap, self.num_threads,
+                               num_batches=self.consensus_batches,
+                               banded=self.banded))
+        return self._engines
+
+    def _run_shard(self, si: int, shard: List[int], entry: dict,
+                   manifest: dict, beat) -> None:
+        sleep_s = flags.get_float("RACON_TPU_EXEC_SLEEP_S")
+        if sleep_s > 0 and si > 0:
+            time.sleep(sleep_s)  # test hook: widen the kill window
+        entry["status"] = mf.RUNNING
+        mf.save_manifest(self.work_dir, manifest)
+        # per-shard attribution: the deltas are a process-wide dict, so
+        # a shard that short-circuits (zero overlaps) must not inherit
+        # the previous shard's compile churn as its own telemetry
+        PhaseRetraceBudget.last_deltas.clear()
+        t0 = time.perf_counter()
+        paths = self._extract_shard(si, shard)
+        extract_s = time.perf_counter() - t0
+
+        fault_shard, fault_always = _fault_spec()
+        records: Optional[List[Tuple[bytes, bytes]]] = None
+        timings: Dict = {}
+        engine_used = "primary"
+        reason = None
+        for attempt, cpu in enumerate((False, True)):
+            try:
+                if si == fault_shard and (fault_always or attempt == 0):
+                    raise RuntimeError(
+                        "injected device-engine fault "
+                        "(RACON_TPU_EXEC_FAULT_SHARD)")
+                records, timings = self._polish_shard(paths, cpu=cpu)
+                engine_used = "cpu-retry" if cpu else "primary"
+                break
+            except Exception as e:
+                warn(f"shard {si} {'CPU retry' if cpu else 'attempt'} "
+                     f"failed: {type(e).__name__}: {e}")
+                if reason is None:
+                    reason = f"{type(e).__name__}: {e}"
+                else:
+                    reason += f"; cpu retry: {type(e).__name__}: {e}"
+
+        if records is None:
+            entry.update(status=mf.QUARANTINED, reason=reason,
+                         wall_s=round(time.perf_counter() - t0, 2))
+            mf.save_manifest(self.work_dir, manifest)
+            shutil.rmtree(os.path.dirname(paths["targets"]),
+                          ignore_errors=True)
+            return
+
+        part = os.path.join(self.work_dir, entry["part"])
+        tmp = part + ".tmp"
+        with open(tmp, "wb") as f:
+            for name, data in records:
+                f.write(b">" + name + b"\n" + data + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, part)
+        mf.fsync_dir(self.work_dir)
+
+        entry.update(
+            status=mf.DONE, engine=engine_used,
+            bytes=os.path.getsize(part),
+            mbp=round(sum(self.index.targets[ci].bases
+                          for ci in shard) / 1e6, 4),
+            wall_s=round(time.perf_counter() - t0, 2),
+            extract_s=round(extract_s, 2),
+            timings=timings,
+            retrace=dict(PhaseRetraceBudget.last_deltas),
+            peak_rss_mb=hb.peak_rss_bytes() >> 20)
+        if reason is not None:
+            entry["reason"] = reason  # first attempt's fault, CPU-retried
+        mf.save_manifest(self.work_dir, manifest)
+        shutil.rmtree(os.path.dirname(paths["targets"]),
+                      ignore_errors=True)
+
+    def _polish_shard(self, paths: Dict[str, str],
+                      cpu: bool) -> Tuple[List[Tuple[bytes, bytes]], Dict]:
+        if paths["n_overlaps"] == 0:
+            return self._unpolished_records(paths), {}
+        aligner, consensus = self._get_engines(cpu)
+        p = create_polisher(
+            paths["reads"], paths["overlaps"], paths["targets"],
+            self.type, window_length=self.window_length,
+            quality_threshold=self.quality_threshold,
+            error_threshold=self.error_threshold, trim=self.trim,
+            match=self.match, mismatch=self.mismatch, gap=self.gap,
+            num_threads=self.num_threads, aligner=aligner,
+            consensus=consensus, window_type=self.index.window_type,
+            prefiltered_overlaps=True, evict_reads=True)
+        polished = p.run(not self.include_unpolished)
+        return [(s.name, s.data) for s in polished], dict(p.timings)
+
+    def _unpolished_records(self, paths) -> List[Tuple[bytes, bytes]]:
+        """A shard whose contigs kept no overlaps at all: a single-shot
+        run drops them unless ``-u``, where it emits the raw (uppercased)
+        targets with zero-coverage tags — replicated here because a
+        Polisher would refuse the empty overlap set."""
+        if not self.include_unpolished:
+            return []
+        out = []
+        tag_prefix = b"r" if self.type == PolisherType.F else b""
+        for rec in parsers.sequence_parser_for(paths["targets"])(
+                paths["targets"]):
+            data = rec.data.upper()
+            tags = tag_prefix + b" LN:i:%d RC:i:0 XC:f:%.6f" % (
+                len(data), 0.0)
+            out.append((rec.name + tags, data))
+        return out
+
+    # ----------------------------------------------------- shard extraction
+
+    def _extract_shard(self, si: int, shard: List[int]) -> Dict[str, str]:
+        """Write this shard's input triple from the original files by
+        byte range (deterministic, so a retried/resumed shard sees the
+        identical inputs)."""
+        d = os.path.join(self.work_dir, f"shard_{si:04d}")
+        os.makedirs(d, exist_ok=True)
+        idx = self.index
+
+        t_ext = _plain_ext(self.target_sequences,
+                           parsers.SEQUENCE_EXTENSIONS, ".fasta")
+        tgt_path = os.path.join(d, "targets" + t_ext)
+        with open(tgt_path, "wb") as f:
+            parsers.copy_byte_ranges(
+                self.target_sequences,
+                [(idx.targets[ci].start, idx.targets[ci].end)
+                 for ci in shard], f)
+
+        line_ids = np.concatenate(
+            [idx.lines_of_contig(ci) for ci in shard]) \
+            if shard else np.zeros(0, np.int64)
+        line_ids = line_ids[np.argsort(idx.ov_start[line_ids],
+                                       kind="stable")]
+        read_ords = np.unique(idx.ov_read[line_ids])
+
+        r_ext = _plain_ext(self.sequences, parsers.SEQUENCE_EXTENSIONS,
+                           ".fasta")
+        reads_path = os.path.join(d, "reads" + r_ext)
+        with open(reads_path, "wb") as f:
+            parsers.copy_byte_ranges(
+                self.sequences,
+                [(int(idx.read_spans[r, 0]), int(idx.read_spans[r, 1]))
+                 for r in read_ords], f)
+
+        ovl_path = os.path.join(d, "overlaps." + idx.overlap_fmt)
+        ranges = [(int(idx.ov_start[i]), int(idx.ov_end[i]))
+                  for i in line_ids]
+        with open(ovl_path, "wb") as f:
+            if idx.overlap_fmt == "mhap":
+                # MHAP addresses records by file ordinal: rewrite the two
+                # id columns to the shard-local 1-based positions
+                read_pos = {int(r): k for k, r in enumerate(read_ords)}
+                contig_pos = {ci: k for k, ci in enumerate(shard)}
+                owners = [int(idx.ov_target[i]) for i in line_ids]
+                reads = [int(idx.ov_read[i]) for i in line_ids]
+                for blob, t_idx, r_ord in zip(
+                        parsers.iter_byte_ranges(self.overlaps, ranges),
+                        owners, reads):
+                    fields = blob.split()
+                    fields[0] = b"%d" % (read_pos[r_ord] + 1)
+                    fields[1] = b"%d" % (contig_pos[t_idx] + 1)
+                    f.write(b" ".join(fields) + b"\n")
+            else:
+                parsers.copy_byte_ranges(self.overlaps, ranges, f)
+
+        return {"targets": tgt_path, "reads": reads_path,
+                "overlaps": ovl_path, "n_overlaps": len(line_ids)}
+
+    # ----------------------------------------------------------- part merge
+
+    def _merge_parts(self, manifest: dict, out) -> None:
+        """Concatenate part records back into target-file contig order
+        (the LPT pack scatters contigs across shards; a single-shot run
+        emits them in file order). Records stream through verbatim."""
+        owner = self.plan.owner_of()
+        readers: Dict[int, "_PartReader"] = {}
+        tag = b"r" if self.type == PolisherType.F else b""
+        try:
+            for ci, target in enumerate(self.index.targets):
+                si = owner[ci]
+                entry = manifest["shards"][si]
+                if entry["status"] != mf.DONE:
+                    continue  # quarantined: nothing to emit
+                if si not in readers:
+                    readers[si] = _PartReader(
+                        os.path.join(self.work_dir, entry["part"]))
+                readers[si].emit_if(target.name + tag, out)
+        finally:
+            for r in readers.values():
+                r.close()
+        out.flush()
+
+
+class _PartReader:
+    """Sequential reader over one part file's 2-line FASTA records, with
+    one-record lookahead (a dropped/unpolished contig leaves its slot
+    empty — the pending record then belongs to a later contig)."""
+
+    def __init__(self, path: str):
+        self.f = open(path, "rb")
+        self.pending: Optional[Tuple[bytes, bytes]] = None
+        self._advance()
+
+    def _advance(self) -> None:
+        header = self.f.readline()
+        if not header:
+            self.pending = None
+            return
+        data = self.f.readline()
+        token = header[1:].split(None, 1)[0]
+        self.pending = (token, header + data)
+
+    def emit_if(self, token: bytes, out) -> bool:
+        if self.pending is not None and self.pending[0] == token:
+            out.write(self.pending[1])
+            self._advance()
+            return True
+        return False
+
+    def close(self) -> None:
+        self.f.close()

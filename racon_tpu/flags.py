@@ -92,6 +92,20 @@ REGISTRY: Dict[str, Flag] = _declare([
          "(-fsanitize=address,undefined) into a separate shared object; "
          "loading it requires the ASan runtime preloaded (see "
          "ci/checks/native_sanitize.sh)."),
+    # -------------------------------------------------- streaming shard runs
+    Flag("RACON_TPU_HEARTBEAT_S", "30", "float",
+         "Streaming shard runner heartbeat interval in seconds (0 "
+         "disables the periodic line; per-shard completion lines always "
+         "print)."),
+    Flag("RACON_TPU_EXEC_FAULT_SHARD", "", "str",
+         "Test hook: inject a device-engine fault before polishing the "
+         "named shard ('2' faults shard 2's first attempt, exercising "
+         "the CPU retry; '2*' faults every attempt, exercising "
+         "quarantine)."),
+    Flag("RACON_TPU_EXEC_SLEEP_S", "0", "float",
+         "Test hook: sleep this many seconds before polishing every "
+         "shard after the first (lets kill/resume tests land a SIGKILL "
+         "mid-run deterministically)."),
     # -------------------------------------------------------- tests, bench
     Flag("RACON_TPU_SLOW", "0", "bool",
          "Enable the slow (tier-2) test set."),
@@ -106,6 +120,10 @@ REGISTRY: Dict[str, Flag] = _declare([
     Flag("RACON_TPU_BENCH_FUSED", "1", "bool",
          "bench.py fused run()-vs-split A/B (and its bit-identity "
          "assert); set 0 to skip."),
+    Flag("RACON_TPU_BENCH_SHARDS", "100", "float",
+         "bench.py streaming shard-runner workload size in Mbp for the "
+         "scaling-curve entry (includes a 4-shard-vs-single-shot "
+         "bit-identity assert at a smaller scale; 0 disables)."),
 ])
 
 

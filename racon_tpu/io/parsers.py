@@ -223,6 +223,159 @@ def _parse_sam_py(path: str) -> Iterator[OverlapRecord]:
             ))
 
 
+# --------------------------------------------------- indexed byte-range IO
+#
+# The streaming shard runner (racon_tpu.exec) does one cheap metadata pass
+# over each input (names + byte spans only, no payloads) and later re-reads
+# just the spans a shard needs. Offsets are DECOMPRESSED-stream offsets, so
+# the same coordinates work for plain and gzipped files: plain files seek,
+# gzipped files take one forward streamed-inflate pass per shard (the
+# native chunked-inflate LineReader shares that floor). Spans are copied
+# verbatim, so multi-line records, comments and exact quality bytes
+# round-trip bit-for-bit.
+
+@dataclass
+class RecordSpan:
+    """One sequence record's location: ``[start, end)`` byte span in the
+    decompressed stream, plus the metadata the index pass needs (name as
+    the parser would truncate it, payload base count, quality flag)."""
+    name: bytes
+    start: int
+    end: int
+    bases: int
+    has_quality: bool = False
+
+
+def _scan_fasta_spans(path: str) -> Iterator[RecordSpan]:
+    pos = 0
+    name = None
+    start = 0
+    bases = 0
+    with open_maybe_gzip(path) as f:
+        for raw in f:
+            line_start = pos
+            pos += len(raw)
+            line = raw.rstrip()
+            if not line:
+                continue
+            if line.startswith(b">"):
+                if name is not None:
+                    yield RecordSpan(name, start, line_start, bases)
+                name = _first_token(line[1:])
+                start = line_start
+                bases = 0
+            else:
+                bases += len(line)
+        if name is not None:
+            yield RecordSpan(name, start, pos, bases)
+
+
+def _scan_fastq_spans(path: str) -> Iterator[RecordSpan]:
+    with open_maybe_gzip(path) as f:
+        pos = 0
+        it = iter(f)
+        for raw in it:
+            start = pos
+            pos += len(raw)
+            header = raw.rstrip()
+            if not header:
+                continue
+            if not header.startswith(b"@"):
+                raise ValueError(
+                    f"malformed FASTQ header in {path}: {header[:40]!r}")
+            name = _first_token(header[1:])
+            bases = 0
+            for raw in it:
+                pos += len(raw)
+                line = raw.rstrip()
+                if line.startswith(b"+"):
+                    break
+                bases += len(line)
+            qlen = 0
+            while qlen < bases:
+                try:
+                    raw = next(it)
+                except StopIteration:
+                    raise ValueError(
+                        f"truncated FASTQ record for {name!r} in "
+                        f"{path}") from None
+                pos += len(raw)
+                qlen += len(raw.rstrip())
+            yield RecordSpan(name, start, pos, bases, True)
+
+
+def scan_sequence_spans(path: str):
+    """Record-span scan of a FASTA/FASTQ file (same extension dispatch,
+    name truncation and multi-line tolerance as the real parsers — the
+    spans of two adjacent records tile the file). Returns an iterator of
+    :class:`RecordSpan`, or None for unsupported extensions."""
+    if _has_suffix(path, FASTQ_EXTENSIONS):
+        return _scan_fastq_spans(path)
+    if _has_suffix(path, SEQUENCE_EXTENSIONS):
+        return _scan_fasta_spans(path)
+    return None
+
+
+def scan_line_spans(path: str) -> Iterator[tuple]:
+    """``(start, end, stripped_line)`` per raw line of a (possibly
+    gzipped) text file — the overlap-index pass walks PAF/MHAP/SAM files
+    through this so kept lines can later be copied verbatim by span."""
+    pos = 0
+    with open_maybe_gzip(path) as f:
+        for raw in f:
+            start = pos
+            pos += len(raw)
+            yield start, pos, raw.rstrip()
+
+
+def iter_byte_ranges(path: str, ranges) -> Iterator[bytes]:
+    """Yield the raw decompressed bytes of each sorted, non-overlapping
+    ``(start, end)`` range. Plain files seek straight to each range;
+    gzipped files take a single forward pass (inflate cannot seek)."""
+    f = open(path, "rb")
+    try:
+        if f.peek(2)[:2] == b"\x1f\x8b":
+            with io.BufferedReader(gzip.open(f)) as g:  # type: ignore[arg-type]
+                pos = 0
+                for start, end in ranges:
+                    if start < pos:
+                        raise ValueError("ranges must be sorted and "
+                                         "non-overlapping")
+                    while pos < start:
+                        skipped = len(g.read(min(1 << 20, start - pos)))
+                        if not skipped:
+                            raise ValueError(f"range past EOF in {path}")
+                        pos += skipped
+                    parts = []
+                    while pos < end:
+                        chunk = g.read(min(1 << 20, end - pos))
+                        if not chunk:
+                            raise ValueError(f"range past EOF in {path}")
+                        parts.append(chunk)
+                        pos += len(chunk)
+                    yield b"".join(parts)
+        else:
+            with f:
+                for start, end in ranges:
+                    f.seek(start)
+                    data = f.read(end - start)
+                    if len(data) != end - start:
+                        raise ValueError(f"range past EOF in {path}")
+                    yield data
+    finally:
+        f.close()
+
+
+def copy_byte_ranges(path: str, ranges, out) -> int:
+    """Append each range's raw bytes to the binary stream ``out``;
+    returns the byte count copied."""
+    total = 0
+    for blob in iter_byte_ranges(path, ranges):
+        out.write(blob)
+        total += len(blob)
+    return total
+
+
 def _has_suffix(path: str, suffixes) -> bool:
     return any(path.endswith(s) for s in suffixes)
 

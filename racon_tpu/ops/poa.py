@@ -83,6 +83,7 @@ from jax import lax
 
 from .nw import _nw_wavefront_kernel, _walk_ops_kernel
 from .pallas_nw import PallasDispatchMixin
+from .. import sanitize
 from ..core.window import WindowType
 
 # Alignment band for layer-vs-backbone-span alignment (layers are ~window
@@ -829,6 +830,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # outputs, guarded per geometry by swar.swar_fits and globally
         # by the swar_ok probe — the knob exists for A/B measurement
         self.use_swar = use_swar
+        # sanitizer: per-engine shadow sampler for the refine loop (the
+        # first SWAR group of every run is always checked) — the
+        # consensus-side analog of TpuAligner._shadow
+        self._shadow = sanitize.ShadowSampler()
         self._warmup = None
         # wavefront_steps: executed (post-gating) DP anti-diagonal steps,
         # the honest numerator for utilization estimates (bench.py)
@@ -1264,9 +1269,38 @@ class TpuPoaConsensus(PallasDispatchMixin):
         launch["pallas_key"] = None
         self._dispatch_rounds(launch, Lq, Lb, steps, Lq2, False, sw)
 
+    _STATE_NAMES = ("bg", "ed", "bcodes", "bweights", "blen", "covs",
+                    "ever", "frozen", "conv", "dropped")
+
     def _dispatch_rounds(self, launch, Lq, Lb, steps, Lq2,
                          use_pallas, use_swar=False) -> None:
-        static, state = launch["static"], launch["state"]
+        pre_state = launch["state"]
+        out = self._dispatch_loop(launch, pre_state, Lq, Lb, steps, Lq2,
+                                  use_pallas, use_swar)
+        launch["state"] = list(out[:10])
+        if launch["nd"] == 1:
+            launch["fetch2"] = out[10:12]
+        if use_swar and self._shadow.should_shadow():
+            # int32 shadow execution of the WHOLE refine loop from the
+            # same pre-round state (the packed forward DP is the only
+            # difference — its bit-exactness contract makes every output
+            # comparable, telemetry included). Sampled per group, so the
+            # sanitizer's cost stays bounded on long runs.
+            shadow = self._dispatch_loop(launch, pre_state, Lq, Lb, steps,
+                                         Lq2, use_pallas, False)
+            from ..parallel import fetch_global
+            sanitize.shadow_compare(
+                fetch_global(list(out[:10])),
+                fetch_global(list(shadow[:10])),
+                self._STATE_NAMES,
+                f"consensus SWAR group (Lq={Lq}, "
+                f"band={launch.get('band', self.band)}, steps={steps})")
+
+    def _dispatch_loop(self, launch, state, Lq, Lb, steps, Lq2,
+                       use_pallas, use_swar):
+        """One full refinement-loop dispatch from an explicit state (the
+        shadow path re-runs the identical launch with ``use_swar`` off)."""
+        static = launch["static"]
         rounds = launch.get("rounds", self.rounds)
         band = launch.get("band", self.band)
         theta = jnp.float32(self.ins_theta)
@@ -1275,21 +1309,17 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # single execution: rounds + the coalesced-fetch packing
             # (single-device only: the packed concat would force
             # cross-shard gathers under a mesh)
-            out = _refine_loop_packed(
+            return _refine_loop_packed(
                 *static, *state, theta, beta, rounds=rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
                 use_swar=use_swar, Lq2=Lq2, scores=self.scores)
-            launch["state"] = list(out[:10])
-            launch["fetch2"] = out[10:12]
-        else:
-            from ..parallel import sharded_refine_loop
-            out = sharded_refine_loop(
-                self.mesh, static, state, theta, beta, rounds=rounds,
-                n_windows_local=launch["nWp"], max_len=Lq, band=band,
-                Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-                use_swar=use_swar, Lq2=Lq2, scores=self.scores)
-            launch["state"] = list(out)
+        from ..parallel import sharded_refine_loop
+        return sharded_refine_loop(
+            self.mesh, static, state, theta, beta, rounds=rounds,
+            n_windows_local=launch["nWp"], max_len=Lq, band=band,
+            Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
+            use_swar=use_swar, Lq2=Lq2, scores=self.scores)
 
     def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
                      Lq2, band) -> None:

@@ -11,6 +11,12 @@ Re-creates the observable CLI contract of the reference's vendored
   ``DIR/<basename>_<i>.<ext>`` chunks whose sequence bytes stay under
   ``chunk_size`` each (input order preserved).
 
+Plus one racon-tpu extension: ``rampler plan <sequences> <overlaps>
+<targets> [--shards N | --max-ram SIZE | --split BYTES]`` prints the
+streaming shard runner's plan (contig assignment + per-shard cost
+estimates, JSON) without running anything — the dry-run surface for
+sizing a large polish before committing hours to it.
+
 Outputs are uncompressed FASTA, or FASTQ when the input records carry
 qualities. Subsampling is deterministic by default (``--seed``, default 0)
 so wrapper runs are reproducible; pass a different seed for new samples.
@@ -94,6 +100,40 @@ def split(sequences_path: str, chunk_size: int, out_dir: str) -> List[str]:
     return out_paths
 
 
+def plan(sequences_path: str, overlaps_path: str, target_path: str,
+         n_shards: int = 0, max_ram: str = "", split_bytes: int = 0,
+         fragment_correction: bool = False,
+         error_threshold: float = 0.3) -> dict:
+    """Dry-run shard plan (see module docstring): index the inputs, run
+    the planner, return the JSON-ready plan summary. ``-f``/``-e`` must
+    match the eventual racon invocation — they change the global overlap
+    filter and therefore the per-shard cost estimates."""
+    from .core.polisher import PolisherType
+    from .exec import build_index, parse_ram, plan_shards
+    from .exec.heartbeat import peak_rss_bytes
+
+    index = build_index(sequences_path, overlaps_path, target_path,
+                        PolisherType.F if fragment_correction
+                        else PolisherType.C, error_threshold)
+    sp = plan_shards(index, n_shards,
+                     parse_ram(max_ram) if max_ram else 0, split_bytes,
+                     base_rss=peak_rss_bytes())
+    return {
+        "mode": sp.mode,
+        "n_contigs": len(index.targets),
+        "n_overlaps": int(len(index.ov_start)),
+        "total_mbp": round(sum(t.bases for t in index.targets) / 1e6, 4),
+        "budget_bytes": sp.budget_bytes,
+        "avail_bytes": sp.avail_bytes,
+        "shards": [{
+            "id": si,
+            "contigs": [index.targets[ci].name.decode("utf-8", "replace")
+                        for ci in shard],
+            "est_resident_mb": sp.costs[si] >> 20,
+        } for si, shard in enumerate(sp.shards)],
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="rampler",
@@ -114,9 +154,34 @@ def main(argv=None) -> int:
     pp.add_argument("sequences")
     pp.add_argument("chunk_size", type=int)
 
-    args = p.parse_args(argv)
-    os.makedirs(args.out_directory, exist_ok=True)
+    pl = sub.add_parser("plan", help="print the streaming shard runner's "
+                                     "plan without running anything")
+    pl.add_argument("sequences")
+    pl.add_argument("overlaps")
+    pl.add_argument("target_sequences")
+    pl.add_argument("--shards", type=int, default=0)
+    pl.add_argument("--max-ram", default="")
+    pl.add_argument("--split", type=int, default=0)
+    pl.add_argument("-f", "--fragment-correction", action="store_true",
+                    help="plan for fragment correction (keep-all overlap "
+                         "filter) — must match the racon invocation")
+    pl.add_argument("-e", "--error-threshold", type=float, default=0.3,
+                    help="overlap error threshold — must match the racon "
+                         "invocation")
 
+    args = p.parse_args(argv)
+
+    if args.mode == "plan":
+        import json
+
+        print(json.dumps(plan(args.sequences, args.overlaps,
+                              args.target_sequences, args.shards,
+                              args.max_ram, args.split,
+                              args.fragment_correction,
+                              args.error_threshold), indent=1))
+        return 0
+
+    os.makedirs(args.out_directory, exist_ok=True)
     if args.mode == "subsample":
         subsample(args.sequences, args.reference_length, args.coverage,
                   args.out_directory, args.seed)

@@ -189,9 +189,12 @@ def retrace_count(prefixes: Sequence[str] = ("racon_tpu",)) -> int:
 class PhaseRetraceBudget:
     """Context manager asserting a pipeline phase compiles at most
     ``budget`` new jit entries (default from
-    ``RACON_TPU_SANITIZE_RETRACE_BUDGET``). No-op when the sanitizer is
-    off. The delta is recorded in :attr:`last_deltas` either way the
-    phase exits cleanly, so benches can report per-phase compile churn.
+    ``RACON_TPU_SANITIZE_RETRACE_BUDGET``). The delta is **always**
+    measured and recorded in :attr:`last_deltas` on a clean exit (the
+    scan walks already-imported modules — microseconds per phase — so
+    bench.py reports and the shard runner's heartbeat line get compile
+    churn without paying for shadow execution); the budget itself is
+    only *enforced* when the sanitizer is armed.
 
     ``prefixes`` scopes the counted modules: the polisher's align phase
     counts the aligner kernel modules only, so consensus compiles from
@@ -213,15 +216,16 @@ class PhaseRetraceBudget:
 
     def __enter__(self):
         self._armed = enabled()
-        if self._armed:
-            self._start = retrace_count(self.prefixes)
+        self._start = retrace_count(self.prefixes)
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        if not self._armed or exc_type is not None:
+        if exc_type is not None:
             return False
         delta = retrace_count(self.prefixes) - self._start
         PhaseRetraceBudget.last_deltas[self.phase] = delta
+        if not self._armed:
+            return False
         budget = (self.budget if self.budget is not None
                   else flags.get_int("RACON_TPU_SANITIZE_RETRACE_BUDGET"))
         if delta > budget:

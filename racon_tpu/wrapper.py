@@ -7,10 +7,17 @@ then polish each chunk with a separate ``racon`` process run sequentially
 (chunk-level restartability: a crash loses at most one chunk,
 ``racon_wrapper.py:125-135``). Polished FASTA is concatenated on stdout.
 
-The chunk runs are subprocesses (``python -m racon_tpu.cli``) like the
-reference's, so each chunk's memory is returned to the OS before the next
-chunk starts — the wrapper is the memory-bound and restartability story
-for inputs larger than RAM.
+The split path now routes through the in-process streaming shard runner
+(:mod:`racon_tpu.exec`) by default: same byte-bounded target chunks, but
+with the contig->overlap index (each chunk reads only its own overlaps
+and reads instead of re-parsing the whole files), engine reuse across
+chunks (one warm-up compile instead of one per subprocess), a checkpoint
+manifest (a crashed ``--split`` run resumes from completed chunks on
+plain re-invocation — the runner's work dir is derived from the inputs,
+not this wrapper's throwaway directory), and per-shard CPU
+retry/quarantine. ``--legacy-split`` keeps the original rampler +
+per-chunk-subprocess path as the fallback (each chunk's memory returned
+to the OS wholesale).
 """
 
 from __future__ import annotations
@@ -33,7 +40,9 @@ class RaconWrapper:
                  subsample, include_unpolished, fragment_correction,
                  window_length, quality_threshold, error_threshold, match,
                  mismatch, gap, threads, tpupoa_batches=0,
-                 tpu_banded_alignment=False, tpualigner_batches=0):
+                 tpu_banded_alignment=False, tpualigner_batches=0,
+                 legacy_split=False):
+        self.legacy_split = legacy_split
         self.sequences = os.path.abspath(sequences)
         self.overlaps = os.path.abspath(overlaps)
         self.target_sequences = os.path.abspath(target_sequences)
@@ -101,6 +110,42 @@ class RaconWrapper:
         else:
             subsampled = self.sequences
 
+        if self.chunk_size is not None and not self.legacy_split:
+            # default split path: the in-process streaming shard runner
+            # (same byte-bounded chunks, plus indexed input extraction,
+            # engine reuse and the checkpoint manifest)
+            from .core.polisher import PolisherType
+            from .exec import ShardRunner
+
+            eprint("[RaconWrapper::run] processing data with the "
+                   "streaming shard runner")
+            runner = ShardRunner(
+                subsampled, self.overlaps, self.target_sequences,
+                type_=PolisherType.F if self.fragment_correction
+                else PolisherType.C,
+                window_length=self.window_length,
+                quality_threshold=self.quality_threshold,
+                error_threshold=self.error_threshold,
+                match=self.match, mismatch=self.mismatch, gap=self.gap,
+                num_threads=self.threads,
+                aligner_backend="tpu" if self.tpualigner_batches > 0
+                else "auto",
+                consensus_backend="tpu" if self.tpupoa_batches > 0
+                else "auto",
+                aligner_batches=max(1, self.tpualigner_batches),
+                consensus_batches=max(1, self.tpupoa_batches),
+                banded=self.tpu_banded_alignment,
+                include_unpolished=self.include_unpolished,
+                max_target_bytes=self.chunk_size,
+                # derived (input-hashed) work dir OUTSIDE the wrapper's
+                # throwaway time-stamped directory, plus resume=True: a
+                # crashed --split run picks up from its checkpoint on
+                # plain re-invocation, and a fresh run starts clean
+                # because a stale manifest cannot match this input set
+                work_dir=None, resume=True, keep_work_dir=False)
+            runner.run(sys.stdout.buffer)
+            return
+
         split_targets = []
         if self.chunk_size is not None:
             self._run_module("racon_tpu.rampler",
@@ -166,7 +211,12 @@ def main(argv=None) -> int:
                                                  "gzipped) targets")
     parser.add_argument("--split", type=int,
                         help="split target sequences into chunks of desired "
-                             "size in bytes")
+                             "size in bytes (runs through the streaming "
+                             "shard runner; see --legacy-split)")
+    parser.add_argument("--legacy-split", action="store_true",
+                        help="use the original rampler-split + sequential "
+                             "per-chunk subprocess path instead of the "
+                             "in-process streaming shard runner")
     parser.add_argument("--subsample", nargs=2, type=int,
                         metavar=("REFERENCE_LENGTH", "COVERAGE"),
                         help="subsample sequences to desired coverage given "
@@ -203,7 +253,7 @@ def main(argv=None) -> int:
         args.window_length, args.quality_threshold, args.error_threshold,
         args.match, args.mismatch, args.gap, args.threads,
         args.tpupoa_batches, args.tpu_banded_alignment,
-        args.tpualigner_batches)
+        args.tpualigner_batches, args.legacy_split)
     with racon:
         racon.run()
     return 0

@@ -1,0 +1,570 @@
+"""Streaming shard-run subsystem (``racon_tpu.exec``).
+
+The concluding contract under test: sharded runs are **byte-identical**
+to the single-shot FASTA — across shard counts, gzipped inputs, MHAP id
+rewriting, fragment-correction mode, a SIGKILL mid-run followed by
+``--resume``, and a corrupt/truncated manifest. Plus the fault story (an
+injected per-shard device fault is retried on the CPU engines; a
+persistent one is quarantined with a logged reason instead of killing
+the run), the planner's LPT/budget modes, read eviction, and the
+heartbeat/manifest observability surface.
+"""
+
+import gzip
+import io
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from test_columnar_init import write_synthetic_assembly
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.exec import (ShardRunner, build_index, load_manifest,
+                            parse_ram, plan_shards)
+from racon_tpu.exec.manifest import MANIFEST_NAME
+from racon_tpu.io import parsers
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def single_shot(rp, pp, lp, drop_unpolished=True, type_=PolisherType.C):
+    """Reference output: the plain Polisher surface, CLI byte format."""
+    p = create_polisher(str(rp), str(pp), str(lp), type_, num_threads=4)
+    return b"".join(b">" + s.name + b"\n" + s.data + b"\n"
+                    for s in p.run(drop_unpolished))
+
+
+def sharded(rp, pp, lp, work_dir, **kw):
+    kw.setdefault("num_threads", 4)
+    runner = ShardRunner(str(rp), str(pp), str(lp), work_dir=str(work_dir),
+                         **kw)
+    buf = io.BytesIO()
+    summary = runner.run(buf)
+    return buf.getvalue(), summary
+
+
+@pytest.fixture()
+def assembly(tmp_path):
+    return write_synthetic_assembly(tmp_path, seed=5, n_contigs=4,
+                                    contig=2500)
+
+
+# ------------------------------------------------------------------- index
+
+def test_index_replays_global_filter(tmp_path):
+    """The index pass must keep exactly what _filter_overlaps keeps:
+    error>threshold and self overlaps drop, contig polishing keeps the
+    longest overlap per *consecutive-run* query group (later line wins
+    ties) — including a query whose groups are split by another query's
+    line (two kept overlaps, not one)."""
+    lp = tmp_path / "t.fasta"
+    lp.write_bytes(b">A\n" + b"ACGT" * 300 + b"\n>B\n" + b"TGCA" * 300
+                   + b"\n")
+    rp = tmp_path / "r.fasta"
+    rp.write_bytes(b">r1\n" + b"ACGT" * 250 + b"\n>r2\n" + b"ACGT" * 250
+                   + b"\n")
+
+    def paf(q, ql, qb, qe, t, tl, tb, te):
+        return b"\t".join([q, b"%d" % ql, b"%d" % qb, b"%d" % qe, b"+",
+                           t, b"%d" % tl, b"%d" % tb, b"%d" % te,
+                           b"50", b"100", b"255"]) + b"\n"
+
+    pp = tmp_path / "o.paf"
+    pp.write_bytes(
+        # group 1 of r1: two lines, second is longer -> kept
+        paf(b"r1", 1000, 0, 100, b"A", 1200, 0, 100)
+        + paf(b"r1", 1000, 0, 400, b"A", 1200, 0, 400)
+        # r2's line splits r1's groups
+        + paf(b"r2", 1000, 0, 300, b"A", 1200, 100, 400)
+        # group 2 of r1 (same query, NEW group) -> kept too
+        + paf(b"r1", 1000, 0, 200, b"B", 1200, 0, 200)
+        # error > 0.3 -> dropped inside its group
+        + paf(b"r2", 1000, 0, 50, b"B", 1200, 0, 500))
+    idx = build_index(str(rp), str(pp), str(lp))
+    kept = list(zip(idx.ov_read.tolist(), idx.ov_target.tolist()))
+    # r1->A (the 400-span line), r2->A, r1->B; the high-error r2->B gone
+    assert kept == [(0, 0), (1, 0), (0, 1)]
+    # the kept r1->A line is the longer SECOND line of its group
+    assert idx.ov_start[0] > 0
+
+
+def test_index_empty_sets_raise(tmp_path):
+    lp = tmp_path / "t.fasta"
+    lp.write_bytes(b">A\nACGT\n")
+    rp = tmp_path / "r.fasta"
+    rp.write_bytes(b">r1\nACGT\n")
+    pp = tmp_path / "o.paf"
+    pp.write_bytes(b"")
+    with pytest.raises(ValueError, match="empty overlap set"):
+        build_index(str(rp), str(pp), str(lp))
+    # unsupported overlap extension: the same clean error a single-shot
+    # create_polisher raises, not a parser crash deep in the scan
+    bad = tmp_path / "o.txt"
+    bad.write_bytes(b"whatever\n")
+    with pytest.raises(ValueError, match="unsupported format extension"):
+        build_index(str(rp), str(bad), str(lp))
+
+
+def test_scan_spans_tile_the_file(assembly):
+    rp, pp, lp = assembly
+    for path, parse in ((rp, parsers.parse_fastq), (lp, parsers.parse_fasta)):
+        spans = list(parsers.scan_sequence_spans(str(path)))
+        recs = list(parse(str(path)))
+        assert [s.name for s in spans] == [r.name for r in recs]
+        assert [s.bases for s in spans] == [len(r.data) for r in recs]
+        assert spans[0].start == 0
+        assert spans[-1].end == os.path.getsize(path)
+        for a, b in zip(spans, spans[1:]):
+            assert a.end == b.start
+        # a copied span re-parses to the identical record
+        blob = next(parsers.iter_byte_ranges(str(path),
+                                             [(spans[1].start,
+                                               spans[1].end)]))
+        part = path.parent / ("one" + path.suffix)
+        part.write_bytes(blob)
+        rec = list(parse(str(part)))[0]
+        assert (rec.name, rec.data, rec.quality) == \
+            (recs[1].name, recs[1].data, recs[1].quality)
+
+
+# ----------------------------------------------------------------- planner
+
+def test_parse_ram():
+    assert parse_ram("4G") == 4 << 30
+    assert parse_ram("500M") == 500 << 20
+    assert parse_ram("64k") == 64 << 10
+    assert parse_ram("100") == 100 << 20  # plain number = MB
+
+
+def test_planner_modes(assembly):
+    rp, pp, lp = assembly
+    idx = build_index(str(rp), str(pp), str(lp))
+    # explicit shard count: exact bins, clamped to the contig count
+    assert plan_shards(idx, n_shards=3).n_shards == 3
+    assert plan_shards(idx, n_shards=99).n_shards == 4
+    # every contig appears exactly once
+    plan = plan_shards(idx, n_shards=3)
+    assert sorted(ci for s in plan.shards for ci in s) == [0, 1, 2, 3]
+    # a huge budget collapses to one shard
+    assert plan_shards(idx, max_ram_bytes=1 << 40,
+                       base_rss=0).n_shards == 1
+    # split mode bounds per-shard TARGET bytes (wrapper --split semantics)
+    t_bases = [t.bases for t in idx.targets]
+    sp = plan_shards(idx, max_target_bytes=max(t_bases) + 1)
+    assert sp.mode == "split"
+    for b in sp.shards:
+        if len(b) > 1:
+            assert sum(t_bases[ci] for ci in b) <= max(t_bases) + 1
+
+
+def test_planner_max_ram_budget_packing():
+    """Budget mode at realistic scale (synthetic index: eight 100 MB-ish
+    contigs, 1 GB budget over a 200 MB base): the shard count grows until
+    every multi-contig bin fits the available budget, and a single
+    oversized contig gets its own shard instead of failing."""
+    from types import SimpleNamespace
+
+    class FakeIndex:
+        def __init__(self, t_bases, read_b, ov_b):
+            self.targets = [SimpleNamespace(bases=b, name=b"c%d" % i)
+                            for i, b in enumerate(t_bases)]
+            self._r = np.asarray(read_b, np.int64)
+            self._o = np.asarray(ov_b, np.int64)
+
+        def contig_read_bytes(self):
+            return self._r
+
+        def contig_overlap_bytes(self):
+            return self._o
+
+    mb = 1 << 20
+    idx = FakeIndex([100 * mb] * 8, [90 * mb] * 8, [10 * mb] * 8)
+    plan = plan_shards(idx, max_ram_bytes=1 << 30, base_rss=200 * mb)
+    assert plan.mode == "max-ram" and plan.n_shards > 1
+    assert sorted(ci for s in plan.shards for ci in s) == list(range(8))
+    for b, cost in zip(plan.shards, plan.costs):
+        if len(b) > 1:
+            assert cost <= plan.avail_bytes
+    # one contig bigger than the whole budget: own shard, run proceeds
+    idx2 = FakeIndex([100 * mb, 4096 * mb], [0, 0], [0, 0])
+    plan2 = plan_shards(idx2, max_ram_bytes=1 << 30, base_rss=0)
+    assert [len(s) for s in plan2.shards] == [1, 1]
+
+
+# -------------------------------------------------------------- invariance
+
+def test_shard_invariance(assembly, tmp_path, capfd):
+    """--shards N output == single-shot output, for N in {1, 3}; the
+    heartbeat emits per-shard completion lines with retrace counters."""
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    got3, summary = sharded(rp, pp, lp, tmp_path / "w3", n_shards=3)
+    assert got3 == want
+    assert summary["n_shards"] == 3
+    assert not summary["quarantined"]
+    got1, _ = sharded(rp, pp, lp, tmp_path / "w1", n_shards=1)
+    assert got1 == want
+    err = capfd.readouterr().err
+    assert "[racon_tpu::exec] shard 0 done engine=primary" in err
+    assert "retrace[" in err and "peak_rss=" in err
+    # per-shard stats carry the init breakdown incl. the slice-and-append
+    # cost (the "move layer storage columnar" ROADMAP decision input)
+    done = summary["shards"][0]
+    assert "layer_append_s" in done["timings"]
+    assert "align_s" in done["timings"]
+
+
+def test_shard_invariance_gz_and_mhap(assembly, tmp_path):
+    """Gzipped inputs (forward streamed-inflate range reads) and MHAP
+    overlaps (file-ordinal ids rewritten per shard) stay byte-identical."""
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    gz = {}
+    for src, name in ((rp, "reads.fastq.gz"), (pp, "ovl.paf.gz"),
+                      (lp, "layout.fasta.gz")):
+        dst = tmp_path / name
+        with open(src, "rb") as f, gzip.open(dst, "wb") as g:
+            g.write(f.read())
+        gz[name] = dst
+    got, _ = sharded(gz["reads.fastq.gz"], gz["ovl.paf.gz"],
+                     gz["layout.fasta.gz"], tmp_path / "wgz", n_shards=3)
+    assert got == want
+
+    # PAF -> MHAP conversion (ids are 1-based file ordinals)
+    rid = {r.name: i + 1 for i, r in
+           enumerate(parsers.parse_fastq(str(rp)))}
+    tid = {t.name: i + 1 for i, t in
+           enumerate(parsers.parse_fasta(str(lp)))}
+    lines = []
+    for _s, _e, line in parsers.scan_line_spans(str(pp)):
+        f = line.split(b"\t")
+        lines.append(b" ".join([
+            b"%d" % rid[f[0]], b"%d" % tid[f[5]], b"0.1", b"0",
+            b"1" if f[4] == b"-" else b"0", f[2], f[3], f[1],
+            b"0", f[7], f[8], f[6]]) + b"\n")
+    mp = tmp_path / "ovl.mhap"
+    mp.write_bytes(b"".join(lines))
+    want_mhap = single_shot(rp, mp, lp)
+    got_mhap, _ = sharded(rp, mp, lp, tmp_path / "wmh", n_shards=3)
+    assert got_mhap == want_mhap
+
+
+def test_fragment_mode_invariance(assembly, tmp_path):
+    """-f self-correction (targets == reads, keep-all filter): the
+    hardest resolution case — every query name is also a target name."""
+    rp, _pp, _lp = assembly
+    recs = list(parsers.parse_fastq(str(rp)))
+    ava = []
+    for a, b in zip(recs, recs[1:]):
+        ln = min(len(a.data), len(b.data)) // 2
+        for q, t in ((a, b), (b, a)):
+            ava.append(b"\t".join([
+                q.name, b"%d" % len(q.data), b"0", b"%d" % ln, b"+",
+                t.name, b"%d" % len(t.data), b"0", b"%d" % ln,
+                b"%d" % (ln // 2), b"%d" % ln, b"255"]) + b"\n")
+    ap = tmp_path / "ava.paf"
+    ap.write_bytes(b"".join(ava))
+    want = single_shot(rp, ap, rp, drop_unpolished=False,
+                       type_=PolisherType.F)
+    got, _ = sharded(rp, ap, rp, tmp_path / "wf", n_shards=4,
+                     type_=PolisherType.F, include_unpolished=True)
+    assert got == want
+    assert got.count(b">") == len(recs)
+
+
+def test_unpolished_only_shard_matches_single_shot(assembly, tmp_path):
+    """A contig with zero kept overlaps can land alone in a shard; with
+    -u the single-shot run emits it raw with zero-coverage tags — the
+    runner synthesizes the identical record (a Polisher would refuse the
+    empty overlap set)."""
+    rp, pp, lp = assembly
+    targets = list(parsers.parse_fasta(str(lp)))
+    victim = targets[1].name
+    kept = [line + b"\n" for _s, _e, line in parsers.scan_line_spans(
+        str(pp)) if line.split(b"\t")[5] != victim]
+    pp2 = tmp_path / "cut.paf"
+    pp2.write_bytes(b"".join(kept))
+    want = single_shot(rp, pp2, lp, drop_unpolished=False)
+    got, summary = sharded(rp, pp2, lp, tmp_path / "wu", n_shards=4,
+                           include_unpolished=True)
+    assert got == want
+    assert b">" + victim + b" LN:i:%d RC:i:0" % len(targets[1].data) in got
+
+
+def test_cli_shards_matches_plain_cli(assembly, tmp_path):
+    """End-to-end through the actual CLI: --shards 3 stdout must equal
+    the plain CLI's stdout byte for byte."""
+    rp, pp, lp = assembly
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(*extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "racon_tpu", "-t", "4", *extra,
+             str(rp), str(pp), str(lp)],
+            capture_output=True, timeout=600, cwd=REPO_ROOT, env=env)
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        return proc.stdout
+
+    plain = run()
+    shard = run("--shards", "3", "--shard-dir", str(tmp_path / "cli_w"))
+    assert shard == plain
+
+
+def test_wrapper_split_routes_through_runner(assembly, tmp_path):
+    """racon_wrapper --split goes through the in-process shard runner by
+    default and must reproduce the plain CLI's bytes; --legacy-split
+    keeps the subprocess path and must too."""
+    rp, pp, lp = assembly
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    t_bytes = sum(len(t.data) for t in parsers.parse_fasta(str(lp)))
+
+    def run(module, *extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", module, "-t", "4", *extra,
+             str(rp), str(pp), str(lp)],
+            capture_output=True, timeout=600, cwd=str(tmp_path), env=env)
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        return proc
+
+    # the wrapper defaults to 5/-4/-8 scores (upstream discrepancy kept
+    # for parity) — pin racon's own defaults for the comparison
+    scores = ["-m", "3", "-x", "-5", "-g", "-4"]
+    plain = run("racon_tpu.cli").stdout
+    via_runner = run("racon_tpu.wrapper", "--split", str(t_bytes // 2),
+                     *scores)
+    assert via_runner.stdout == plain
+    assert b"streaming shard runner" in via_runner.stderr
+    legacy = run("racon_tpu.wrapper", "--split", str(t_bytes // 2),
+                 "--legacy-split", *scores)
+    assert legacy.stdout == plain
+    assert b"streaming shard runner" not in legacy.stderr
+
+
+# ---------------------------------------------------------- fault handling
+
+def test_injected_fault_retries_on_cpu(assembly, tmp_path, monkeypatch):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_EXEC_FAULT_SHARD", "1")
+    got, summary = sharded(rp, pp, lp, tmp_path / "w", n_shards=4)
+    assert got == want  # CPU retry produced the identical bytes
+    entry = summary["shards"][1]
+    assert entry["status"] == "done"
+    assert entry["engine"] == "cpu-retry"
+    assert "injected device-engine fault" in entry["reason"]
+    assert not summary["quarantined"]
+
+
+def test_persistent_fault_quarantines_without_killing_run(
+        assembly, tmp_path, monkeypatch):
+    rp, pp, lp = assembly
+    monkeypatch.setenv("RACON_TPU_EXEC_FAULT_SHARD", "2*")
+    got, summary = sharded(rp, pp, lp, tmp_path / "w", n_shards=4,
+                           keep_work_dir=True)
+    assert summary["quarantined"] == [2]
+    entry = summary["shards"][2]
+    assert entry["status"] == "quarantined"
+    assert "injected device-engine fault" in entry["reason"]
+    assert "cpu retry" in entry["reason"]
+    # the other three shards' contigs still came out
+    assert got.count(b">") == 3
+    # the manifest on disk records the quarantine reason
+    m = load_manifest(str(tmp_path / "w"))
+    assert m["shards"][2]["status"] == "quarantined"
+    assert "injected" in m["shards"][2]["reason"]
+    # resume after the fault clears re-runs ONLY the quarantined shard
+    monkeypatch.delenv("RACON_TPU_EXEC_FAULT_SHARD")
+    want = single_shot(rp, pp, lp)
+    got2, summary2 = sharded(rp, pp, lp, tmp_path / "w", n_shards=4,
+                             resume=True, keep_work_dir=True)
+    assert got2 == want
+    assert all(e["status"] == "done" for e in summary2["shards"])
+
+
+# ------------------------------------------------------------------ resume
+
+def test_resume_skips_completed_shards(assembly, tmp_path, capfd):
+    rp, pp, lp = assembly
+    want, summary = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                            keep_work_dir=True)
+    parts = sorted((tmp_path / "w").glob("part_*.fasta"))
+    assert len(parts) == 3
+    mtimes = [p.stat().st_mtime_ns for p in parts]
+    got, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3, resume=True,
+                     keep_work_dir=True)
+    assert got == want
+    err = capfd.readouterr().err
+    assert err.count("resume: skipping completed shard") == 3
+    # untouched part files: nothing re-ran
+    assert [p.stat().st_mtime_ns for p in parts] == mtimes
+
+
+def test_resume_adopts_stored_plan_when_replan_drifts(assembly, tmp_path,
+                                                      monkeypatch, capfd):
+    """A --max-ram plan depends on the planning process's live RSS, so a
+    resume can legitimately recompute a DIFFERENT plan. The resume must
+    adopt the manifest's stored plan (the one the parts were cut by)
+    and skip all completed shards, not discard hours of work."""
+    import racon_tpu.exec.runner as runner_mod
+    from racon_tpu.exec.planner import plan_shards as real_plan
+
+    rp, pp, lp = assembly
+    want, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                      keep_work_dir=True)
+
+    def drifted(index, n_shards=0, max_ram_bytes=0, max_target_bytes=0,
+                base_rss=0):
+        return real_plan(index, n_shards=2)  # simulated RSS-shifted plan
+
+    monkeypatch.setattr(runner_mod, "plan_shards", drifted)
+    got, summary = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                           resume=True, keep_work_dir=True)
+    assert got == want
+    assert summary["n_shards"] == 3  # stored plan adopted, not the drift
+    err = capfd.readouterr().err
+    assert err.count("resume: skipping completed shard") == 3
+
+
+def test_resume_ignores_sizing_knobs(assembly, tmp_path, capfd):
+    """A bare `racon --resume` (no --shards/--max-ram repeated) must
+    trust the checkpoint: shard boundaries never change the merged
+    bytes, so the stored plan is adopted and completed shards skip."""
+    rp, pp, lp = assembly
+    want, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                      keep_work_dir=True)
+    got, summary = sharded(rp, pp, lp, tmp_path / "w", resume=True,
+                           keep_work_dir=True)  # no sizing knobs at all
+    assert got == want
+    assert summary["n_shards"] == 3  # the stored plan, not a fresh one
+    err = capfd.readouterr().err
+    assert err.count("resume: skipping completed shard") == 3
+
+
+def test_resume_param_mismatch_reruns_everything(assembly, tmp_path,
+                                                 capfd):
+    """Output-shaping parameters ARE fingerprinted: resuming with a
+    different quality threshold must not trust the old parts."""
+    rp, pp, lp = assembly
+    want3, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                       keep_work_dir=True)
+    # '9'-quality reads pass both thresholds, so the bytes stay equal —
+    # but the runner cannot know that and must re-run
+    got, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3, resume=True,
+                     keep_work_dir=True, quality_threshold=9.5)
+    assert got == want3
+    err = capfd.readouterr().err
+    assert "fingerprint does not match" in err
+    assert "resume: skipping" not in err
+
+
+def test_corrupt_manifest_recovery(assembly, tmp_path, capfd):
+    """A truncated manifest (torn write, disk full) must not wedge the
+    run: resume warns, re-plans and reproduces the byte-identical
+    output."""
+    rp, pp, lp = assembly
+    want, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                      keep_work_dir=True)
+    mpath = tmp_path / "w" / MANIFEST_NAME
+    blob = mpath.read_bytes()
+    mpath.write_bytes(blob[:len(blob) // 2])  # torn mid-object
+    got, summary = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                           resume=True, keep_work_dir=True)
+    assert got == want
+    assert all(e["status"] == "done" for e in summary["shards"])
+    err = capfd.readouterr().err
+    assert "corrupt" in err and "re-running every shard" in err
+
+
+@pytest.mark.parametrize("kill_after_parts", [1])
+def test_sigkill_then_resume_byte_identical(assembly, tmp_path,
+                                            kill_after_parts):
+    """The acceptance scenario: SIGKILL the CLI mid-shard (a test-hook
+    sleep widens the window), then --resume; the final FASTA must be
+    byte-identical to an uninterrupted run and completed shards must not
+    re-run."""
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    wd = tmp_path / "w"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["RACON_TPU_EXEC_SLEEP_S"] = "8"
+    args = [sys.executable, "-m", "racon_tpu", "-t", "2", "--shards", "4",
+            "--shard-dir", str(wd), str(rp), str(pp), str(lp)]
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, cwd=REPO_ROOT, env=env)
+
+    def done_count():
+        # the manifest is written atomically, so polling it is safe; a
+        # shard only counts once its part file is durable AND recorded
+        m = load_manifest(str(wd))
+        return (sum(e["status"] == "done" for e in m["shards"])
+                if m else 0)
+
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if done_count() >= kill_after_parts:
+                break
+            if proc.poll() is not None:
+                pytest.fail("runner exited before the kill window: "
+                            + proc.stderr.read().decode()[-2000:])
+            time.sleep(0.1)
+        else:
+            pytest.fail("no completed shard appeared before the deadline")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode != 0  # killed, not completed
+    m = load_manifest(str(wd))
+    assert m is not None
+    done = [e for e in m["shards"] if e["status"] == "done"]
+    assert 0 < len(done) < 4  # interrupted mid-run, checkpoint intact
+
+    env.pop("RACON_TPU_EXEC_SLEEP_S")
+    proc = subprocess.run(args + ["--resume"], capture_output=True,
+                          timeout=600, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert proc.stdout == want
+    err = proc.stderr.decode()
+    assert "resume: skipping completed shard" in err
+
+
+# ---------------------------------------------------------------- eviction
+
+def test_evict_reads_releases_payloads_and_preserves_output(assembly):
+    rp, pp, lp = assembly
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=1,
+                        evict_reads=True)
+    p.initialize()
+    # reads (everything past the targets) hold no payload bytes anymore
+    assert all(len(s.data) == 0 and s._reverse_complement is None
+               for s in p.sequences[p.targets_size:])
+    evicted = b"".join(b">" + s.name + b"\n" + s.data + b"\n"
+                       for s in p.polish(True))
+    assert evicted == single_shot(rp, pp, lp)
+
+
+# ----------------------------------------------------------- rampler plan
+
+def test_rampler_plan_cli(assembly, capsys):
+    from racon_tpu import rampler
+
+    rp, pp, lp = assembly
+    assert rampler.main(["plan", str(rp), str(pp), str(lp),
+                         "--shards", "3"]) == 0
+    plan = json.loads(capsys.readouterr().out)
+    assert plan["mode"] == "shards"
+    assert plan["n_contigs"] == 4
+    assert len(plan["shards"]) == 3
+    assert sum(len(s["contigs"]) for s in plan["shards"]) == 4

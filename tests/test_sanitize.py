@@ -64,6 +64,89 @@ def _seed_packed_corruption(monkeypatch):
 
 # ------------------------------------------------------ shadow execution
 
+def _stress_windows(n=6, ln=120, depth=5, seed=3):
+    from racon_tpu.core.window import Window, WindowType
+
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    windows = []
+    for wi in range(n):
+        truth = bases[rng.integers(0, 4, ln)]
+        bb = truth.copy()
+        flips = rng.random(ln) < 0.1
+        bb[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        win = Window(0, wi, WindowType.TGS, bb.tobytes(), b"!" * ln)
+        for _ in range(depth):
+            lay = truth.copy()
+            flips = rng.random(ln) < 0.08
+            lay[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+            win.add_layer(lay.tobytes(), b"9" * ln, 0, ln - 1)
+        windows.append(win)
+    return windows
+
+
+def _consensus_engine():
+    from racon_tpu.core.backends import CpuPoaConsensus
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    return TpuPoaConsensus(3, -5, -4,
+                           fallback=CpuPoaConsensus(3, -5, -4, 2),
+                           use_swar=True)
+
+
+def _seed_consensus_packed_corruption(monkeypatch):
+    """Consensus-side analog of :func:`_seed_packed_corruption`: the
+    packed refine loop's fetched coverage comes back off by one (what a
+    wrapped packed lane downstream of the forward DP would produce),
+    while the int32 loop stays correct."""
+    from racon_tpu.ops import poa, swar
+
+    real = poa._refine_loop_packed
+
+    def corrupt(*args, **kw):
+        out = real(*args, **kw)
+        if kw.get("use_swar"):
+            out = list(out)
+            out[5] = out[5] + 1  # covs
+            out = tuple(out)
+        return out
+
+    monkeypatch.setattr(poa, "_refine_loop_packed", corrupt)
+    monkeypatch.setattr(swar, "_SWAR_OK", True)
+
+
+def test_consensus_shadow_catches_seeded_corruption(sanitize_on,
+                                                    monkeypatch):
+    """Shadow execution now covers the consensus refine loop too
+    (ROADMAP r8 follow-up): a packed-path-only corruption of the
+    device-resident state is caught bit-for-bit."""
+    _seed_consensus_packed_corruption(monkeypatch)
+    with pytest.raises(sanitize.SwarShadowMismatch,
+                       match="consensus SWAR group.*covs"):
+        _consensus_engine().run(_stress_windows(), trim=True)
+
+
+def test_consensus_corruption_silent_without_sanitizer(monkeypatch):
+    monkeypatch.delenv("RACON_TPU_SANITIZE", raising=False)
+    _seed_consensus_packed_corruption(monkeypatch)
+    flags = _consensus_engine().run(_stress_windows(), trim=True)
+    assert len(flags) == 6  # shipped silently — why the shadow exists
+
+
+def test_consensus_clean_under_sanitizer(sanitize_on):
+    """No seeded fault: the sanitized SWAR consensus passes its shadow
+    and emits the same bytes as the int32 engine."""
+    from racon_tpu.core.backends import CpuPoaConsensus
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    wa = _stress_windows(seed=11)
+    wb = _stress_windows(seed=11)
+    _consensus_engine().run(wa, trim=True)
+    TpuPoaConsensus(3, -5, -4, fallback=CpuPoaConsensus(3, -5, -4, 2),
+                    use_swar=False).run(wb, trim=True)
+    assert [w.consensus for w in wa] == [w.consensus for w in wb]
+
+
 def test_swar_shadow_catches_seeded_overflow(sanitize_on, monkeypatch):
     from racon_tpu.ops.nw import TpuAligner
 

@@ -116,3 +116,42 @@ def simulate(mbp: float, seed: int = 23, coverage: int = 30,
 
     return (b"".join(fastq_parts), b"".join(paf_lines),
             b"".join(fasta_parts), truths)
+
+
+def write_inputs(mbp: float, out_dir: str, seed: int = 23,
+                 coverage: int = 30) -> dict:
+    """Generate and write the input triple (+ truth contigs) to
+    ``out_dir``. Exists as a CLI so benches can generate big workloads in
+    a THROWAWAY subprocess: a 100 Mbp set materializes several GB of read
+    bytes, and generating in-process would bake that into the parent's
+    peak RSS — exactly the number the shard-runner bench budgets."""
+    import os
+
+    reads, paf, contigs, truths = simulate(mbp, seed=seed,
+                                           coverage=coverage)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {"reads": os.path.join(out_dir, "reads.fastq"),
+             "overlaps": os.path.join(out_dir, "ovl.paf"),
+             "draft": os.path.join(out_dir, "draft.fasta"),
+             "truth": os.path.join(out_dir, "truth.fasta")}
+    truth_fa = b"".join(b">contig_%d\n%s\n" % (i, t)
+                        for i, t in enumerate(truths))
+    for key, blob in (("reads", reads), ("overlaps", paf),
+                      ("draft", contigs), ("truth", truth_fa)):
+        with open(paths[key], "wb") as f:
+            f.write(blob)
+    return paths
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="write a simulated assembly input triple "
+                    "(reads.fastq, ovl.paf, draft.fasta, truth.fasta)")
+    ap.add_argument("mbp", type=float)
+    ap.add_argument("out_dir")
+    ap.add_argument("--seed", type=int, default=23)
+    ap.add_argument("--coverage", type=int, default=30)
+    a = ap.parse_args()
+    write_inputs(a.mbp, a.out_dir, seed=a.seed, coverage=a.coverage)
