@@ -59,12 +59,20 @@ def bench_consensus(windows):
         warm = min(warm, time.perf_counter() - t0)
     log(f"warm (best of 2): {warm:.2f}s")
 
+    # matmul vote path: insertion fold overflow is structurally
+    # impossible (the r05 96-window run recorded 265 events); the
+    # RACON_TPU_MATMUL_VOTES=0 A/B leg may legitimately overflow
+    if tpu.use_matmul_votes:
+        assert tpu.stats["ins_overflow"] == 0, tpu.stats
+
     log("CPU consensus baseline...")
     t0 = time.perf_counter()
     cpu.run(windows, trim=True)
     cpu_t = time.perf_counter() - t0
     log(f"cpu: {cpu_t:.2f}s")
-    return cold, warm, cpu_t, dict(tpu.stats)
+    stats = dict(tpu.stats)
+    stats["pack"] = tpu.pack_metrics()
+    return cold, warm, cpu_t, stats
 
 
 def bench_aligner():
@@ -255,6 +263,7 @@ def bench_scale():
     windows = build_stress_windows(mbp)
     n_windows = len(windows)
     cpu = CpuPoaConsensus(3, -5, -4, 8)
+    # default engine: ragged packing + int8-matmul votes (round 10)
     tpu = TpuPoaConsensus(3, -5, -4, fallback=cpu, num_batches=4)
     log(f"scale probe: {n_windows} stress windows ({mbp} Mbp), cold...")
     t0 = time.perf_counter()
@@ -269,13 +278,55 @@ def bench_scale():
         t0 = time.perf_counter()
         tpu.run(windows, trim=True)
         warm = min(warm, time.perf_counter() - t0)
+    out_ref = [w.consensus for w in windows]
+    out_bytes = sum(len(c) for c in out_ref)
     # the stress shapes must actually exercise the reject contract (the
     # stress kinds recur every 50 windows, so tiny override sizes may
     # legitimately not contain them)
     if n_windows >= 100:
         assert tpu.stats["fallback_windows"] > 0, tpu.stats
-        assert tpu.stats["dropped_layers"] > 0, tpu.stats
         assert tpu.stats["passthrough"] > 0, tpu.stats
+        # silent-layer-loss guard (round 10): the depth-cap component of
+        # dropped_layers is deterministic from the window set, so the
+        # counter must cover at least it — a regression that stops
+        # counting (or stops feeding the per-run warn line) fails here
+        # instead of silently at assembly scale
+        expected_drops = sum(max(0, w.layer_count - tpu.max_depth)
+                             for w in windows)
+        assert expected_drops > 0, "stress set lost its deep windows"
+        assert tpu.stats["dropped_layers"] >= expected_drops, (
+            tpu.stats["dropped_layers"], expected_drops)
+    # the matmul vote path has no insertion fold cap: overflow events
+    # are structurally impossible (265 of them at r05); the
+    # RACON_TPU_MATMUL_VOTES=0 A/B leg may legitimately overflow
+    if tpu.use_matmul_votes:
+        assert tpu.stats["ins_overflow"] == 0, tpu.stats
+    pack = tpu.pack_metrics()
+    log(f"scale pack: {pack}")
+
+    # A/B grid vs the r05 configuration ({padded, ragged} x {scatter,
+    # matmul}): same windows, byte-identical consensus on every path —
+    # the speedup is recorded at fixed output bytes, not prose
+    def ab(label, ragged, mm, warm_runs=1):
+        eng = TpuPoaConsensus(3, -5, -4, fallback=cpu, num_batches=4,
+                              use_ragged=ragged, use_matmul_votes=mm)
+        log(f"scale A/B ({label}): cold...")
+        eng.run(windows, trim=True)  # cold (compiles)
+        best = float("inf")
+        for _ in range(warm_runs):
+            t0 = time.perf_counter()
+            eng.run(windows, trim=True)
+            best = min(best, time.perf_counter() - t0)
+        outs = [w.consensus for w in windows]
+        assert outs == out_ref, f"consensus diverged on {label}"
+        log(f"scale A/B ({label}): {best:.2f}s ({mbp / best:.3f} Mbp/s), "
+            f"output byte-identical")
+        return best
+
+    warm_ps = ab("padded+scatter, the r05 path", False, False,
+                 warm_runs=2)
+    warm_pm = ab("padded+matmul", False, True)
+    warm_rs = ab("ragged+scatter", True, False)
     # packed-vs-int32 A/B on the same windows (bit-exact outputs, so
     # the delta is pure wavefront wall-clock)
     log("scale probe (int32 lanes) for the packed comparison...")
@@ -293,36 +344,31 @@ def bench_scale():
     cpu_t = time.perf_counter() - t0
     log(f"scale cpu: {cpu_t:.2f}s ({mbp / cpu_t:.3f} Mbp/s)")
     log(f"scale warm: {warm:.2f}s ({n_windows / warm:.1f} windows/s, "
-        f"{mbp / warm:.3f} Mbp/s)")
-    # device-utilization estimate at scale: EXECUTED DP lane-updates
-    # (the engine counts post-convergence-gating wavefront steps on
-    # device — pairs whose window converged are zeroed and do no DP, so
-    # skipped work is not credited) x band/2 lanes x ~20 VPU ops per
-    # lane-update, vs the VPU's rough int32 peak (8x128 lanes x 2
-    # ops/cycle x ~0.94 GHz on v5e). Walk/vote/rebuild work rides along
-    # uncounted, so this is a lower bound on busy-ness but an honest
-    # count of useful alignment work per wall-second.
-    from racon_tpu.ops.poa import BAND
-    cells = tpu.stats["wavefront_steps"] * (tpu.stats.get("band", BAND) // 2)
-    # "effective" utilization: useful lane-updates against the int32
-    # 1-value-per-lane peak. The packed path retires two int16 lanes per
-    # VPU slot, so a halved wall-clock reads as doubled effective
-    # utilization — exactly the tentpole's >=2x framing; the int32 run's
-    # own estimate rides along for the A/B.
-    vpu_util = cells * 20 / warm / (8 * 128 * 2 * 0.94e9)
-    vpu_util32 = cells * 20 / warm32 / (8 * 128 * 2 * 0.94e9)
+        f"{mbp / warm:.3f} Mbp/s, {warm_ps / warm:.2f}x over "
+        f"padded+scatter)")
     return {
         "scale_mbp": mbp,
         "scale_windows": n_windows,
         "scale_windows_per_sec": round(n_windows / warm, 2),
         "scale_mbp_per_sec": round(mbp / warm, 4),
+        # fixed-output-bytes proof: every A/B leg above asserted its
+        # consensus byte-identical to the default path's
+        "scale_out_bytes": out_bytes,
+        # the r05 configuration and the single-axis legs (BENCH_r06 A/B)
+        "scale_mbp_per_sec_padded_scatter": round(mbp / warm_ps, 4),
+        "scale_ragged_matmul_speedup": round(warm_ps / warm, 3),
+        "scale_padded_matmul_s": round(warm_pm, 3),
+        "scale_ragged_scatter_s": round(warm_rs, 3),
         "scale_int32_s": round(warm32, 3),
         "consensus_swar_speedup": round(warm32 / warm, 3),
         "scale_cpu_s": round(cpu_t, 3),
         "scale_cpu_mbp_per_sec": round(mbp / cpu_t, 4),
         "scale_vs_cpu": round(cpu_t / warm, 3),
-        "consensus_vpu_util_est": round(vpu_util, 4),
-        "consensus_vpu_util_est_int32": round(vpu_util32, 4),
+        # real pair-arena occupancy (occupied/total lanes, mean windows
+        # per group) — replaces the coarse consensus_vpu_util_est, which
+        # modeled VPU busy-ness from wavefront steps and could not see
+        # padding waste (the 0.018 headline at r05 was ~98% padding)
+        "scale_pack": pack,
         "scale_stats": dict(tpu.stats),
     }
 
@@ -660,7 +706,7 @@ def main():
         "cpu_s": round(cpu_t, 3),
         "consensus_stats": stats,
         **aligner_metrics,
-        **scale_metrics,  # scale_mbp_per_sec + consensus_vpu_util_est
+        **scale_metrics,  # scale_mbp_per_sec + pack occupancy + A/B grid
         **pipeline_metrics,  # full-pipeline Mbp/s + CPU baseline
         **shard_metrics,  # streaming shard-runner scaling curve
         **parse_metrics,

@@ -27,6 +27,19 @@ RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
 # decoder, and the pipelined run() — including the num_threads=1
 # sequential-fallback smoke — before anything slow runs
 python -m pytest tests/test_columnar_init.py tests/test_window.py -q
+# ragged-packing shard (fail-fast, round 10): graftlint gate over the
+# columnar layer store + ragged packer + matmul vote code, then the
+# {padded,ragged} x {scatter,matmul} byte-identity grid — and the same
+# grid again under the runtime sanitizer, so the int32 shadow path
+# proves itself on the packed ragged layout
+# (pallas_nw.py rides along so the interprocedural pass can resolve
+# poa.py's _note_pallas_failure logging sink, like the repo-wide run)
+python -m tools.analysis --quiet racon_tpu/core/layers.py \
+  racon_tpu/core/window.py racon_tpu/ops/poa.py \
+  racon_tpu/ops/pallas_nw.py tests/test_ragged.py
+python -m pytest tests/test_ragged.py -q
+RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
+  python -m pytest tests/test_ragged.py -q
 # streaming shard-run smoke (fail-fast): graftlint-clean gate over the
 # new racon_tpu/exec package, then the invariance suite — including the
 # 2-shard/3-shard byte-identity checks and the SIGKILL-then---resume
@@ -35,7 +48,7 @@ python -m tools.analysis --quiet racon_tpu/exec
 python -m pytest tests/test_exec.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
-  --ignore=tests/test_exec.py
+  --ignore=tests/test_exec.py --ignore=tests/test_ragged.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

@@ -54,6 +54,11 @@ class PythonPoaConsensus:
     # fewer run() calls keep the native thread pool saturated and bound
     # the GIL traffic between the layer producer and the packer
     group_pairs_hint = 1 << 18
+    # optional streaming-session seam (round 10): device engines expose
+    # stream(trim, band_hint) -> session for double-buffered async
+    # dispatch; host engines have no device pipeline to overlap, so the
+    # Polisher falls back to per-range blocking run() calls
+    stream = None
 
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1):
@@ -76,6 +81,7 @@ class NativePoaConsensus:
     native engine flags as failed are re-polished by the Python engine."""
 
     group_pairs_hint = 1 << 18  # see PythonPoaConsensus
+    stream = None               # see PythonPoaConsensus
 
     def __init__(self, match: int, mismatch: int, gap: int,
                  num_threads: int = 1):

@@ -476,6 +476,7 @@ class Polisher:
             if emit is not None:
                 emit(0, n_win)
             self.timings["layer_append_s"] = 0.0
+            self.timings["layer_store_s"] = 0.0
             self.timings["build_windows_s"] = round(
                 self._backbone_s + (time.perf_counter() - t_build), 3)
             return
@@ -558,45 +559,53 @@ class Polisher:
         # order inside each window (the POA's tie-break contract)
         order = kept[np.argsort(win_id[kept], kind="stable")]
         sorted_win = win_id[order]
-        ov_l = pair_ov[order].tolist()
-        qb_l = q_first[order].tolist()
-        qe_l = q_endx[order].tolist()
-        wi_l = sorted_win.tolist()
-        b_l = layer_begin[order].tolist()
-        e_l = layer_end[order].tolist()
 
         windows = self.windows
         if not chunk_windows:
             chunk_windows = n_win
-        # the slice-and-append loop below is the last Python-bound init
-        # cost (~1 µs/layer); it is timed separately (CPU time — the
-        # pipelined producer's wall-clock stretches under GIL sharing)
-        # so BENCH rounds can decide the "move layer storage columnar"
-        # ROADMAP call from shard-scale data
-        t_append = 0.0
+        # columnar layer storage (round 10): ONE deduplicated read pool
+        # plus flat (offset, len, begin, end) rows replace the per-layer
+        # slice-and-append loop that used to dominate init CPU
+        # (layer_append_s); windows get an O(1) lazy view and the device
+        # packers gather their lane blocks straight from the pool
+        from .layers import LayerStore
+        t_store = time.thread_time()
+        store = LayerStore.build(
+            data_refs, qual_refs, pair_ov[order], q_first[order],
+            q_endx[order], sorted_win, layer_begin[order],
+            layer_end[order], n_win)
+        self.timings["layer_store_s"] = round(
+            time.thread_time() - t_store, 3)
+        t_append = time.thread_time()
+        bounds = store.row_bounds
+        # attach chunk-by-chunk and emit each range the moment its
+        # windows have their layers: consumers without a stream()
+        # session (CPU/native engines, mesh runs) start polishing the
+        # first range while later ranges are still attaching — the
+        # round-7 init->polish overlap contract survives the columnar
+        # store (whose vectorized build above is the only remaining
+        # pre-emission serial section). thread_time keeps a blocking
+        # emit (bounded queue put) out of the append accounting.
         for w0 in range(0, n_win, chunk_windows):
             w1 = min(w0 + chunk_windows, n_win)
-            p0, p1 = (int(x) for x in np.searchsorted(sorted_win, [w0, w1]))
-            t_slice = time.thread_time()
-            for wi, ov, qb, qe, lb, le in zip(
-                    wi_l[p0:p1], ov_l[p0:p1], qb_l[p0:p1], qe_l[p0:p1],
-                    b_l[p0:p1], e_l[p0:p1]):
-                win = windows[wi]
-                qual = qual_refs[ov]
-                win.sequences.append(data_refs[ov][qb:qe])
-                win.qualities.append(qual[qb:qe]
-                                     if qual is not None else None)
-                win.positions.append((lb, le))
-            t_append += time.thread_time() - t_slice
+            for wi in range(w0, w1):
+                r0, r1 = int(bounds[wi]), int(bounds[wi + 1])
+                if r1 > r0:
+                    windows[wi].attach_layers(store, r0, r1)
             if emit is not None:
                 emit(w0, w1)
-        self.timings["layer_append_s"] = round(t_append, 3)
+        # the attach loop is all that remains of the old per-layer
+        # append cost — recorded under the same key so BENCH rounds stay
+        # comparable across the columnar transition
+        self.timings["layer_append_s"] = round(
+            time.thread_time() - t_append, 3)
 
         for o in overlaps:
             o.breaking_points = None
         if self.evict_reads:
-            # every layer above holds a *copy* of its span, so the read
-            # pool (data + revcomp + qualities) is dead weight from here
+            # the layer store pooled a copy of every referenced read
+            # orientation above, so the original read payloads
+            # (data + revcomp + qualities) are dead weight from here
             # on — the shard runner's memory budget counts on this
             for seq in self.sequences[self.targets_size:]:
                 seq.release()
@@ -729,6 +738,16 @@ class Polisher:
         msg = "[racon_tpu::Polisher::polish] generating consensus"
         polished: List[bool] = [False] * n_win
         queue_wait = 0.0
+        # double-buffered async dispatch (round 10): a ragged consensus
+        # engine exposes a streaming session — each range is packed and
+        # DISPATCHED as it arrives while earlier groups still compute on
+        # device, and fetch/decode happens behind the in-flight budget
+        # or at finish. Engines without a session (CPU backends, mesh
+        # runs) keep the per-range blocking run() calls.
+        stream_f = getattr(self.consensus, "stream", None)
+        sess = None
+        sess_tried = False
+        fed_ranges: List = []
         try:
             with sanitize.PhaseRetraceBudget(
                 "consensus", prefixes=("racon_tpu.ops.poa",
@@ -744,9 +763,33 @@ class Polisher:
                         break
                     a, b = item
                     if b > a:
-                        polished[a:b] = self.consensus.run(
-                            self.windows[a:b], self.trim)
+                        if stream_f is not None and not sess_tried:
+                            # session opens at the FIRST range: by then
+                            # the layer store is fully built (ranges are
+                            # emitted after the one-pass attach loop),
+                            # so the live-window band hint below equals
+                            # the padded path's batch-global maximum —
+                            # the frozen band, and hence every byte of
+                            # consensus, matches run() on the whole set
+                            sess_tried = True
+                            band_hint = max(
+                                (len(w.backbone) for w in self.windows
+                                 if w.layer_count >= 2), default=0)
+                            sess = stream_f(trim=self.trim,
+                                            band_hint=band_hint)
+                        if sess is not None:
+                            sess.feed(self.windows[a:b])
+                            fed_ranges.append((a, b))
+                        else:
+                            polished[a:b] = self.consensus.run(
+                                self.windows[a:b], self.trim)
                     log.bar_to(msg, b, n_win)
+                if sess is not None:
+                    flags_all = sess.finish()
+                    pos = 0
+                    for a, b in fed_ranges:
+                        polished[a:b] = flags_all[pos:pos + (b - a)]
+                        pos += b - a
         except BaseException:
             # a consensus fault mid-stream must not strand the producer
             # on the bounded queue: drain it and retire the thread

@@ -25,8 +25,17 @@ class WindowType(enum.Enum):
 
 
 class Window:
-    __slots__ = ("id", "rank", "type", "consensus", "sequences", "qualities",
-                 "positions")
+    """Layers live either as real bytes lists (``add_layer``) or as a
+    lazy (store, row-range) view into a columnar
+    :class:`~racon_tpu.core.layers.LayerStore` (``attach_layers``). The
+    ``sequences``/``qualities``/``positions`` properties materialize the
+    view on first access, so every bytes-level consumer (CPU POA
+    engines, tests, goldens) sees identical data either way; the device
+    packers read the store directly (``layer_view``) and never pay the
+    per-layer copies."""
+
+    __slots__ = ("id", "rank", "type", "consensus", "_seqs", "_quals",
+                 "_pos", "_store", "_r0", "_r1")
 
     def __init__(self, id_: int, rank: int, type_: WindowType, backbone: bytes,
                  quality: bytes):
@@ -36,9 +45,91 @@ class Window:
         self.rank = rank
         self.type = type_
         self.consensus: bytes = b""
-        self.sequences: List[bytes] = [backbone]
-        self.qualities: List[Optional[bytes]] = [quality]
-        self.positions: List[Tuple[int, int]] = [(0, 0)]
+        self._seqs: List[bytes] = [backbone]
+        self._quals: List[Optional[bytes]] = [quality]
+        self._pos: List[Tuple[int, int]] = [(0, 0)]
+        self._store = None
+        self._r0 = 0
+        self._r1 = 0
+
+    # ------------------------------------------------------ columnar view
+
+    def attach_layers(self, store, r0: int, r1: int) -> None:
+        """Attach rows [r0, r1) of a columnar layer store as this
+        window's layers (replaces per-layer ``add_layer`` appends).
+
+        The window must hold only its backbone: the device packer reads
+        an attached window's layers as the contiguous store rows
+        [r0, r0+depth), so layers added any other way would silently
+        alias a neighbor's rows (``add_layer`` AFTER attaching is fine —
+        it materializes the view first)."""
+        if self._store is not None or len(self._seqs) > 1:
+            raise ValueError(
+                "attach_layers on a window that already has layers")
+        self._store = store
+        self._r0, self._r1 = r0, r1
+
+    @property
+    def layer_view(self):
+        """(store, r0, r1) — ``store`` is None once materialized (or for
+        windows built through ``add_layer``)."""
+        return self._store, self._r0, self._r1
+
+    @property
+    def layer_count(self) -> int:
+        """Number of read layers (excluding the backbone) WITHOUT
+        materializing a lazy view."""
+        if self._store is not None:
+            return (self._r1 - self._r0) + (len(self._seqs) - 1)
+        return len(self._seqs) - 1
+
+    @property
+    def backbone(self) -> bytes:
+        """Layer 0 without materializing the view."""
+        return self._seqs[0]
+
+    @property
+    def backbone_quality(self) -> bytes:
+        return self._quals[0]
+
+    def _materialize(self) -> None:
+        if self._store is not None:
+            store, r0, r1 = self._store, self._r0, self._r1
+            self._store = None
+            store.materialize_into(self, r0, r1)
+
+    @property
+    def sequences(self) -> List[bytes]:
+        self._materialize()
+        return self._seqs
+
+    @sequences.setter
+    def sequences(self, value) -> None:
+        # direct assignment (tests, ad-hoc window surgery) replaces the
+        # layer list wholesale; materialize first so a pending lazy view
+        # cannot re-append its rows under the new list later
+        self._materialize()
+        self._seqs = list(value)
+
+    @property
+    def qualities(self) -> List[Optional[bytes]]:
+        self._materialize()
+        return self._quals
+
+    @qualities.setter
+    def qualities(self, value) -> None:
+        self._materialize()
+        self._quals = list(value)
+
+    @property
+    def positions(self) -> List[Tuple[int, int]]:
+        self._materialize()
+        return self._pos
+
+    @positions.setter
+    def positions(self, value) -> None:
+        self._materialize()
+        self._pos = list(value)
 
     def add_layer(self, sequence: bytes, quality: Optional[bytes], begin: int,
                   end: int) -> None:
@@ -48,11 +139,12 @@ class Window:
             raise ValueError("unequal quality size")
         # single bounds guard: begin == end already returned above, and
         # begin > backbone_len is unreachable once begin < end <= len
-        if begin > end or end > len(self.sequences[0]):
+        if begin > end or end > len(self._seqs[0]):
             raise ValueError("layer begin and end positions are invalid")
-        self.sequences.append(sequence)
-        self.qualities.append(quality)
-        self.positions.append((begin, end))
+        self._materialize()  # appends must land after any lazy view rows
+        self._seqs.append(sequence)
+        self._quals.append(quality)
+        self._pos.append((begin, end))
 
     def generate_consensus(self, engine, trim: bool) -> bool:
         """Generate the consensus with the given POA engine.

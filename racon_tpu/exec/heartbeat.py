@@ -48,6 +48,7 @@ class Heartbeat:
         self._done = 0
         self._mbp = 0.0
         self._phase = "indexing"
+        self._pack: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -67,7 +68,8 @@ class Heartbeat:
 
     def update(self, done: Optional[int] = None,
                mbp: Optional[float] = None,
-               phase: Optional[str] = None) -> None:
+               phase: Optional[str] = None,
+               pack: Optional[dict] = None) -> None:
         with self._lock:
             if done is not None:
                 self._done = done
@@ -75,15 +77,26 @@ class Heartbeat:
                 self._mbp = mbp
             if phase is not None:
                 self._phase = phase
+            if pack is not None:
+                self._pack = pack
 
     def emit(self, tag: str = "heartbeat") -> None:
         with self._lock:
             done, mbp, phase = self._done, self._mbp, self._phase
+            pack = self._pack
         dt = max(1e-9, time.perf_counter() - self._t0)
+        # real packing occupancy of the consensus pair arenas (round 10):
+        # occupied/total lanes and mean windows per dispatched group —
+        # the replacement for the coarse consensus_vpu_util_est
+        occ = ("-" if not pack or not pack.get("groups") else
+               f"{pack['pack_efficiency']:.2f}eff,"
+               f"{pack['windows_per_group']:.0f}w/g,"
+               f"{pack['groups']}g")
         print(f"[racon_tpu::exec] {tag}: shard {done}/{self.n_shards} "
               f"({phase}) {mbp:.2f} Mbp in {dt:.1f}s "
               f"({mbp / dt:.4f} Mbp/s) "
               f"peak_rss={peak_rss_bytes() >> 20}MB "
+              f"pack[{occ}] "
               f"retrace[{retrace_summary()}]",
               file=self._stream)
         self._stream.flush()

@@ -176,7 +176,8 @@ class ShardRunner:
                 self._run_shard(si, shard, entry, manifest, beat)
                 if entry["status"] == mf.DONE:
                     mbp_done += shard_mbp
-                beat.update(done=si + 1, mbp=mbp_done)
+                beat.update(done=si + 1, mbp=mbp_done,
+                            pack=self._consensus_pack())
                 beat.emit(f"shard {si} {entry['status']} "
                           f"engine={entry.get('engine', '-')}")
             beat.update(phase="merging")
@@ -199,6 +200,7 @@ class ShardRunner:
             "base_rss_bytes": base_rss,
             "budget_bytes": self.plan.budget_bytes,
             "quarantined": [e["id"] for e in quarantined],
+            "consensus_pack": self._consensus_pack() or {},
             "shards": [dict(e) for e in manifest["shards"]],
         }
         if not quarantined and not self.keep_work_dir:
@@ -275,6 +277,16 @@ class ShardRunner:
                                num_batches=self.consensus_batches,
                                banded=self.banded))
         return self._engines
+
+    def _consensus_pack(self) -> Optional[dict]:
+        """Cumulative pair-arena occupancy of the reused device
+        consensus engine (None for CPU-only runs) — feeds the heartbeat
+        ``pack[...]`` field and the run summary."""
+        if self._engines is not None:
+            pm = getattr(self._engines[1], "pack_metrics", None)
+            if pm is not None:
+                return pm()
+        return None
 
     def _run_shard(self, si: int, shard: List[int], entry: dict,
                    manifest: dict, beat) -> None:

@@ -63,6 +63,17 @@ REGISTRY: Dict[str, Flag] = _declare([
     Flag("RACON_TPU_DYNBOUND", "1", "bool",
          "Per-block dynamic sweep bounds in the Pallas kernels; set 0 to "
          "run every block at the static bound for A/B measurement."),
+    Flag("RACON_TPU_RAGGED", "1", "bool",
+         "Ragged window packing in the consensus engine: windows bucket "
+         "by their own size and groups greedy-fill a fixed lane arena "
+         "instead of padding every window to the global bucket maxima; "
+         "set 0 to force the padded single-geometry path for A/B "
+         "measurement."),
+    Flag("RACON_TPU_MATMUL_VOTES", "1", "bool",
+         "Emit consensus column/insertion votes through int8xint8->int32 "
+         "MXU matmuls (exact at any depth, no insertion fold overflow); "
+         "set 0 to restore the f32 one-hot matmul + packed scatter for "
+         "A/B measurement."),
     Flag("RACON_TPU_WARMUP", "1", "bool",
          "Background warm-up compilation of the consensus refinement "
          "loop during Polisher.initialize(); set 0 to disable."),

@@ -83,7 +83,7 @@ from jax import lax
 
 from .nw import _nw_wavefront_kernel, _walk_ops_kernel
 from .pallas_nw import PallasDispatchMixin
-from .. import sanitize
+from .. import flags, sanitize
 from ..core.window import WindowType
 
 # Alignment band for layer-vs-backbone-span alignment (layers are ~window
@@ -102,6 +102,18 @@ GROW = 256
 # the vote accumulation's MXU matmul grows with B x n_windows but stays
 # well under the round-trip cost it buys back.
 MAX_GROUP_PAIRS = 32768
+# Ragged-packing lane arena (round 10, the cudabatch greedy batch-fill
+# analog, SURVEY §L3): a group greedy-fills windows until its pair rows
+# x lane width reach this budget, so short-window buckets carry
+# proportionally MORE pairs per dispatch instead of padding every pair
+# row to the global maxima. Sized to keep the w=500 default bucket at
+# exactly the proven MAX_GROUP_PAIRS geometry (Lq = 1024 there).
+ARENA_LANES = MAX_GROUP_PAIRS * 1024
+# Windows per group ceiling: the vote reduction's [B, n_windows] one-hot
+# matmul and the [n_windows, Lb*(1+K)*CH] vote matrices grow with the
+# window count, so very short windows close a group on this before the
+# lane arena fills.
+MAX_GROUP_WINDOWS = 4096
 # In-flight ceiling for dispatched-but-unfetched groups: each holds its
 # packed inputs (~(2*Lq + ~20) bytes/pair) plus a small output state on
 # device (the big per-round intermediates live only inside the one
@@ -264,10 +276,70 @@ def _shift_rows_left(x, amount, max_amount: int):
     return x
 
 
+def _shift_right(x, sh: int):
+    """Shift lanes away from index 0 by static ``sh``, zero-filling the
+    head (mirror of :func:`_shift_left`; nothing wraps)."""
+    return jnp.pad(x[:, :-sh], ((0, 0), (sh, 0)))
+
+
+def _expand_rows(alive, payload, dist, S: int):
+    """Stable per-row expansion — the mirror of :func:`_compact_rows`:
+    move the alive lane at rank position ``r`` RIGHT by ``dist[r]`` lanes
+    (``dist`` must be >= 0 and non-decreasing over alive lanes, with
+    ``r + dist[r] < S``); vacated and untouched lanes read zero.
+
+    Binary routing like :func:`_compact_rows` but **MSB-first**: pass k
+    moves items whose remaining distance has bit k by 2**k lanes toward
+    the tail. MSB-first is what makes expansion collision-free (LSB-first
+    only works for the dense-rank destinations of compaction): at pass k
+    every item sits at ``dest - (d mod 2^(k+1))``, so a mover i landing
+    on a stayer j would need ``dest_j - dest_i = (d_j - d_i) mod-parts``
+    forcing ``q_i > q_j`` in the bit-k+1 quotients while ``d_i <= d_j``
+    — a contradiction. Used to land per-(column, slot) insertion votes
+    on their absolute column lanes without a scatter."""
+    pays = jnp.where(alive, payload, 0)
+    d = jnp.where(alive, dist, 0)
+    for k in reversed(range((S - 1).bit_length())):
+        sh = 1 << k
+        if sh >= S:
+            continue
+        mov = alive & (((d >> k) & 1) == 1)
+        stay = alive & ~mov
+        mov_s = _shift_right(mov, sh)
+        d_s = _shift_right(d, sh)
+        pays_s = _shift_right(pays, sh)
+        alive = mov_s | stay
+        d = jnp.where(mov_s, d_s, jnp.where(stay, d, 0))
+        pays = jnp.where(mov_s, pays_s, jnp.where(stay, pays, 0))
+    return pays, alive
+
+
+def _int_vote_matmul(ohT8, a_ch, a_w, CH: int):
+    """Exact integer window-reduction of per-lane (channel, weight) votes
+    on the MXU: an int8 x int8 -> int32 matmul pair instead of the f32
+    HIGHEST one-hot matmul. Weights (< 2^13 after alpha scaling) split
+    into two 7-bit limbs so the operands fit int8; int32 accumulation
+    (``preferred_element_type``) is exact at any voting depth up to
+    2^31 / 8184 ≈ 262k — where the f32 path lost integer exactness at
+    2^24 partial sums, the old depth-2047 cap. Returns (weight sums,
+    vote counts), both int32 [nW, L*CH]."""
+    ch_iota = jnp.arange(CH, dtype=jnp.int32)
+    wop = jnp.where(a_ch[:, :, None] == ch_iota, a_w[:, :, None], 0)
+    B = wop.shape[0]
+    flat = wop.reshape(B, -1)
+    lo = (flat & 127).astype(jnp.int8)
+    hi = (flat >> 7).astype(jnp.int8)       # a_w < 2^13 -> hi < 64
+    cnt = (flat > 0).astype(jnp.int8)
+    w = (jnp.matmul(ohT8, lo, preferred_element_type=jnp.int32)
+         + (jnp.matmul(ohT8, hi, preferred_element_type=jnp.int32) << 7))
+    c = jnp.matmul(ohT8, cnt, preferred_element_type=jnp.int32)
+    return w, c
+
+
 def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
                       n_windows: int, L: int, K: int, band: int,
                       scores=(DEFAULT_MATCH, DEFAULT_MISMATCH,
-                              DEFAULT_GAP)):
+                              DEFAULT_GAP), matmul_votes: bool = False):
     """Accumulate the per-step vote stream into per-window matrices —
     shared by both walk backends (identical results by construction).
 
@@ -352,15 +424,68 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
     rev = jnp.flip(comp, axis=1)
     aligned = _shift_rows_left(rev, W2 - bg - span_m, W2)[:, :L]
     a_ch = (aligned >> 13) & (CH - 1)
-    a_w = (aligned & ((1 << 13) - 1)).astype(jnp.float32)
-    ch_iota = jnp.arange(CH, dtype=jnp.int32)
-    wop = jnp.where(a_ch[:, :, None] == ch_iota, a_w[:, :, None], 0.0)
-    cop = (wop > 0).astype(jnp.float32)
-    onehot = ((win_of[:, None] == jnp.arange(nW, dtype=win_of.dtype))
-              & ok[:, None]).astype(jnp.float32)
-    hi = jax.lax.Precision.HIGHEST
-    w_cols = jnp.matmul(onehot.T, wop.reshape(B, L * CH), precision=hi)
-    c_cols = jnp.matmul(onehot.T, cop.reshape(B, L * CH), precision=hi)
+    onemask = ((win_of[:, None] == jnp.arange(nW, dtype=win_of.dtype))
+               & ok[:, None])
+    if matmul_votes:
+        # exact int8/int32 MXU reduction — no f32 partial sums, but the
+        # totals below are still cast to f32 for the consensus kernel,
+        # so the ctor's depth cap must keep them f32-representable
+        # (64-aligned at default scores -> 65535; 2047 otherwise)
+        ohT8 = onemask.astype(jnp.int8).T
+        w_icols, c_icols = _int_vote_matmul(
+            ohT8, a_ch, aligned & ((1 << 13) - 1), CH)
+        w_cols = w_icols.astype(jnp.float32)
+        c_cols = c_icols.astype(jnp.float32)
+    else:
+        a_w = (aligned & ((1 << 13) - 1)).astype(jnp.float32)
+        ch_iota = jnp.arange(CH, dtype=jnp.int32)
+        wop = jnp.where(a_ch[:, :, None] == ch_iota, a_w[:, :, None], 0.0)
+        cop = (wop > 0).astype(jnp.float32)
+        onehot = onemask.astype(jnp.float32)
+        hi = jax.lax.Precision.HIGHEST
+        w_cols = jnp.matmul(onehot.T, wop.reshape(B, L * CH), precision=hi)
+        c_cols = jnp.matmul(onehot.T, cop.reshape(B, L * CH), precision=hi)
+
+    if matmul_votes:
+        # ---- insertion votes as K aligned slot planes through the same
+        # exact matmul (no scatter): per (pair, junction, slot) there is
+        # at most ONE vote — slots of one insertion run are distinct and
+        # distinct runs sit at distinct junction columns — so each slot
+        # plane compacts in walk order (strictly decreasing junction
+        # column) and RIGHT-expands onto absolute column lanes
+        # (:func:`_expand_rows`; destinations ``L-1-col`` are strictly
+        # increasing over ranks). Replaces the fold + packed scatter:
+        # the scatter engine was the slowest op in the round, and the
+        # fold cap's overflow events (``ins_overflow``, 265 in the r05
+        # 96-window bench) are structurally impossible here.
+        iaddr = idx - L * CH
+        icol = iaddr // (K * CH)
+        isub = iaddr - icol * (K * CH)    # slot*CH + ch
+        lane = jnp.arange(W2, dtype=jnp.int32)[None, :]
+        plane_w, plane_c = [], []
+        for s in range(K):
+            sflag = ins_flag & (isub >= s * CH) & (isub < (s + 1) * CH)
+            ipay = ((icol << 16) | ((isub - s * CH) << 13)
+                    | jnp.minimum(w, (1 << 13) - 1))
+            comp_s, alive_s = _compact_rows(sflag, ipay, S)
+            if W2 > S:
+                comp_s = jnp.pad(comp_s, ((0, 0), (0, W2 - S)))
+                alive_s = jnp.pad(alive_s, ((0, 0), (0, W2 - S)))
+            dist = jnp.where(alive_s, (L - 1) - (comp_s >> 16) - lane, 0)
+            exp_s, _ = _expand_rows(alive_s, comp_s, dist, W2)
+            al_s = jnp.flip(exp_s[:, :L], axis=1)
+            ws, cs = _int_vote_matmul(ohT8, (al_s >> 13) & (CH - 1),
+                                      al_s & ((1 << 13) - 1), CH)
+            plane_w.append(ws.reshape(nW, L, CH))
+            plane_c.append(cs.reshape(nW, L, CH))
+        INS = L * K * CH
+        ins_w = jnp.stack(plane_w, axis=2).reshape(nW, INS) \
+            .astype(jnp.float32)
+        ins_c = jnp.stack(plane_c, axis=2).reshape(nW, INS)
+        weighted = jnp.concatenate([w_cols, ins_w], axis=1)
+        unweighted = jnp.concatenate(
+            [c_cols.astype(jnp.int32), ins_c], axis=1)
+        return weighted, unweighted, jnp.int32(0)
 
     # ---- insertion votes: two-level compaction, then one packed scatter
     # level 1 (per pair): an ok pair has < band//2 edits, hence < band//2
@@ -485,14 +610,16 @@ def _consensus_kernel(weighted, unweighted, bcodes, bweights, blen,
 @functools.partial(jax.jit, static_argnames=("n_windows", "max_len", "band",
                                              "Lb", "K", "steps",
                                              "use_pallas", "use_swar",
-                                             "Lq2", "scores"))
+                                             "Lq2", "scores",
+                                             "matmul_votes"))
 def refine_round(n, qpw, win_of, real, bg, ed,
                  bcodes, bweights, blen, covs, ever, frozen, conv,
                  dropped, ins_theta, del_beta, *, n_windows: int,
                  max_len: int, band: int, Lb: int, K: int, steps: int = 0,
                  use_pallas: bool = False, use_swar: bool = False,
                  Lq2: int = 0,
-                 scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
+                 scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP),
+                 matmul_votes: bool = False):
     """One fully-device-resident refinement round.
 
     Align every layer against its current backbone span, vote, pick
@@ -582,7 +709,7 @@ def refine_round(n, qpw, win_of, real, bg, ed,
             bg, max_len=Lq2, band=band, L=Lb, K=K)
     weighted, unweighted, ins_ovf = _accumulate_votes(
         idx, wv, okp, win_of, m, bg, n, score, n_windows=n_windows,
-        L=Lb, K=K, band=band, scores=scores)
+        L=Lb, K=K, band=band, scores=scores, matmul_votes=matmul_votes)
     winner, coverage, ins_winner, ins_emit, ins_cov = _consensus_kernel(
         weighted, unweighted, bcodes, bweights, blen, ins_theta, del_beta,
         L=Lb, K=K)
@@ -673,7 +800,8 @@ def refine_round(n, qpw, win_of, real, bg, ed,
 @functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
                                              "max_len", "band", "Lb", "K",
                                              "steps", "use_pallas",
-                                             "use_swar", "Lq2", "scores"))
+                                             "use_swar", "Lq2", "scores",
+                                             "matmul_votes"))
 def refine_loop(n, qpw, win_of, real, bg, ed,
                 bcodes, bweights, blen, covs, ever, frozen, conv,
                 dropped, ins_theta, del_beta, *, rounds: int,
@@ -681,7 +809,8 @@ def refine_loop(n, qpw, win_of, real, bg, ed,
                 max_len: int, band: int, Lb: int, K: int, steps: int = 0,
                 use_pallas: bool = False, use_swar: bool = False,
                 Lq2: int = 0,
-                scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)):
+                scores=(DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP),
+                matmul_votes: bool = False):
     """All refinement rounds of a group in ONE device dispatch.
 
     ``lax.while_loop`` over :func:`refine_round` — per-round host
@@ -705,7 +834,8 @@ def refine_loop(n, qpw, win_of, real, bg, ed,
             n, qpw, win_of, real, *carry[1:], ins_theta,
             del_beta, n_windows=n_windows, max_len=max_len, band=band,
             Lb=Lb, K=K, steps=steps, use_pallas=use_pallas,
-            use_swar=use_swar, Lq2=Lq2, scores=scores)
+            use_swar=use_swar, Lq2=Lq2, scores=scores,
+            matmul_votes=matmul_votes)
         return (carry[0] + 1,) + tuple(out)
 
     state = (bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
@@ -731,7 +861,8 @@ def _fetch_pack(bcodes, blen, covs, ever, frozen, conv, dropped, bg, ed):
 @functools.partial(jax.jit, static_argnames=("rounds", "n_windows",
                                              "max_len", "band", "Lb", "K",
                                              "steps", "use_pallas",
-                                             "use_swar", "Lq2", "scores"))
+                                             "use_swar", "Lq2", "scores",
+                                             "matmul_votes"))
 def _refine_loop_packed(*args, **kw):
     """refine_loop + the coalesced-fetch packing in ONE jitted program:
     the tunnel charges ~0.5-1.3 s per dispatched execution, so running
@@ -744,21 +875,308 @@ def _refine_loop_packed(*args, **kw):
 
 
 class _Work:
-    """Per-window packing view (layers capped at ``max_depth``)."""
+    """Per-window packing view (layers capped at ``max_depth``).
 
-    __slots__ = ("win", "backbone", "bqual", "layers", "n_seqs")
+    Two storage modes share one packing surface: columnar windows
+    (``win.layer_view`` attached by the polisher) keep ``rows`` indices
+    into the shared :class:`~racon_tpu.core.layers.LayerStore` plus the
+    store's flat ``lens``/``begin``/``end`` slices — the packer then
+    builds the whole group's lane block with one vectorized pool gather;
+    hand-built windows (``add_layer``) keep the legacy bytes tuples and
+    pack through the join-and-LUT path."""
+
+    __slots__ = ("win", "backbone", "bqual", "layers", "n_seqs", "store",
+                 "rows", "lens", "begins", "ends", "n_layers",
+                 "max_layer_len")
 
     def __init__(self, win, max_depth, stats):
         self.win = win
-        self.backbone = win.sequences[0]
-        self.bqual = win.qualities[0]
-        self.layers = []  # (seq, qual, begin, end)
-        depth = min(len(win.sequences) - 1, max_depth)
-        stats["dropped_layers"] += max(0, len(win.sequences) - 1 - max_depth)
-        for li in range(1, depth + 1):
-            b, e = win.positions[li]
-            self.layers.append((win.sequences[li], win.qualities[li], b, e))
-        self.n_seqs = len(win.sequences)
+        self.backbone = win.backbone
+        self.bqual = win.backbone_quality
+        total = win.layer_count
+        stats["dropped_layers"] += max(0, total - max_depth)
+        depth = min(total, max_depth)
+        self.n_seqs = total + 1
+        self.n_layers = depth
+        store, r0, _ = win.layer_view
+        self.store = store
+        if store is not None:
+            self.rows = np.arange(r0, r0 + depth, dtype=np.int64)
+            self.lens = store.length[r0:r0 + depth]
+            self.begins = store.begin[r0:r0 + depth]
+            self.ends = store.end[r0:r0 + depth]
+            self.layers = None
+            self.max_layer_len = int(self.lens.max()) if depth else 0
+        else:
+            self.layers = []  # (seq, qual, begin, end)
+            for li in range(1, depth + 1):
+                b, e = win.positions[li]
+                self.layers.append((win.sequences[li], win.qualities[li],
+                                    b, e))
+            self.lens = np.array([len(s) for s, _, _, _ in self.layers],
+                                 np.int64)
+            self.begins = np.array([b for _, _, b, _ in self.layers],
+                                   np.int64)
+            self.ends = np.array([e for _, _, _, e in self.layers],
+                                 np.int64)
+            self.rows = None
+            self.max_layer_len = int(self.lens.max()) if depth else 0
+
+
+class _ConsensusStream:
+    """Ragged streaming consensus session (round 10).
+
+    Windows arrive through :meth:`feed` in any number of batches; live
+    windows bucket by the power-of-two lane width their OWN backbone and
+    layers need (``_bucket_L``) instead of padding to a global maximum,
+    and every bucket greedy-fills groups against the fixed
+    ``ARENA_LANES`` pair arena — short windows pack proportionally more
+    pairs per dispatch (the cudabatch batch-fill design,
+    ``cudabatch.cpp:54-62``). Full groups dispatch ASYNCHRONOUSLY the
+    moment they close: host packing of the next range overlaps device
+    compute of the previous ones through the bounded in-flight pipeline,
+    and fetches happen only when the in-flight byte budget forces one or
+    at :meth:`finish` — the double-buffered dispatch that stops host
+    fetch/emit from gating the device.
+
+    The alignment **band is frozen at the first dispatch** from the
+    windows seen so far (plus the caller's ``band_hint``), because the
+    band alters alignment outcomes (the ``score < band//2`` accept gate)
+    and per-window consensus must not depend on which batch a window
+    arrived in. ``run()``-style usage (one feed of everything, then
+    finish) therefore reproduces the padded path's band exactly; per-
+    window output is bit-identical to the padded path by construction —
+    windows are independent and the vote accumulation is exact integer
+    arithmetic at any grouping.
+
+    Two-stage refinement carries over per bucket: groups dispatched
+    while more work is expected run ``STAGE_A_ROUNDS`` and collect their
+    unconverged windows; :meth:`finish` coalesces each bucket's
+    stragglers into small stage-B groups (a bucket whose only group is
+    its last runs the full budget directly, like the padded path's
+    single-group rule)."""
+
+    def __init__(self, eng: "TpuPoaConsensus", trim: bool,
+                 band_hint: int = 0, progress=None):
+        self.eng = eng
+        self.trim = trim
+        self.band_hint = band_hint
+        self.windows: List = []            # every fed window, feed order
+        self.results: List[Optional[bool]] = []
+        self.buffer: List = []             # live works awaiting band/bucket
+        self.buffered_pairs = 0
+        self.max_bb_live = 0
+        self.band: Optional[int] = None    # frozen at first dispatch
+        self._Lq_pad = 0                   # padded-path reject caps,
+        self._Lb_pad = 0                   # set when the band freezes
+        self.pending: dict = {}            # bucket L -> [(slot, work)]
+        self.bucket_state: dict = {}       # bucket L -> {groups,steps,Lq2}
+        self.survivors: dict = {}          # bucket L -> stage-B collect
+        self.inflight: List[dict] = []
+        self.inflight_bytes = 0
+        self.fetched = 0
+        self.progress = progress
+        self._done = False
+        self._stats_before = dict(eng.stats)
+
+    # ------------------------------------------------------------- intake
+
+    def feed(self, windows) -> None:
+        """Add a window range; packs and dispatches every group that
+        fills. Returns immediately — dispatch is async, only the
+        in-flight byte budget can force a (pipelined) fetch here."""
+        assert not self._done, "stream already finished"
+        eng = self.eng
+        for win in windows:
+            self.windows.append(win)
+            if win.layer_count + 1 < 3:
+                win.consensus = win.backbone
+                self.results.append(False)
+                eng.stats["passthrough"] += 1
+                continue
+            self.results.append(None)      # None -> CPU fallback unless
+            slot = len(self.results) - 1   # a device group resolves it
+            w = _Work(win, eng.max_depth, eng.stats)
+            if w.n_layers < 2:
+                continue
+            self.buffer.append((slot, w))
+            self.buffered_pairs += w.n_layers
+            self.max_bb_live = max(self.max_bb_live, len(w.backbone))
+        self._flush(final=False)
+
+    # ----------------------------------------------------------- geometry
+
+    def _bucket_L(self, w: "_Work", band: int) -> Optional[int]:
+        """Power-of-two lane-width bucket for one window (None -> the
+        window exceeds every device bucket and takes the CPU fallback,
+        the same reject contract as the padded path's global caps)."""
+        max_dev_L = (1 << 18) // (K_INS * CH) - GROW
+        bb = len(w.backbone)
+        if bb > max_dev_L:
+            # the padded geometry admits backbones into the GROW margin
+            # at the device ceiling (its accept test is bb <= Lb =
+            # min(L + GROW, L + band) with L capped at max_dev_L);
+            # mirror that accept set exactly — the reject set is part
+            # of the ragged/padded byte-identity contract
+            if bb > max_dev_L + min(GROW, band):
+                return None
+            bb = max_dev_L
+        L_req = max(256, bb, w.max_layer_len - band)
+        L = 256
+        while L < L_req:
+            if L >= max_dev_L:
+                return None
+            L = min(L * 2, max_dev_L)
+        return L
+
+    @staticmethod
+    def _cap_pairs(L: int, band: int) -> int:
+        """Greedy-fill pair budget for a bucket: the fixed lane arena
+        divided by this bucket's lane width — short windows pack more
+        pairs per group, the whole point of ragged packing."""
+        return max(2048, min(ARENA_LANES // (L + band),
+                             4 * MAX_GROUP_PAIRS))
+
+    # ----------------------------------------------------------- dispatch
+
+    def _flush(self, final: bool) -> None:
+        eng = self.eng
+        if self.band is None:
+            # freeze the band only once there is enough buffered work to
+            # justify a dispatch (or at finish): a full feed batch has
+            # already been absorbed into max_bb_live at this point, so
+            # run()-style usage sees the batch-global maximum exactly
+            if not self.buffer:
+                return
+            if not final and self.buffered_pairs < MAX_GROUP_PAIRS:
+                return
+            max_bb = max(self.max_bb_live, self.band_hint)
+            # the padded path's geometry from the same live maximum:
+            # its band AND its reject caps. Windows the padded path
+            # would send to the CPU fallback (layers past Lq, backbones
+            # past Lb) must take the CPU fallback here too — the reject
+            # set is part of the byte-identity contract, and per-window
+            # consensus is invariant to bucket size only for windows
+            # both paths actually polish on device
+            self.band, _, self._Lq_pad, self._Lb_pad = \
+                eng._bucket_geometry(max_bb)
+            eng.stats["band"] = self.band
+        band = self.band
+        for slot, w in self.buffer:
+            if (w.max_layer_len > self._Lq_pad
+                    or len(w.backbone) > self._Lb_pad):
+                continue                   # CPU fallback via results None
+            L = self._bucket_L(w, band)
+            if L is None:
+                continue                   # CPU fallback via results None
+            self.pending.setdefault(L, []).append((slot, w))
+        self.buffer = []
+        self.buffered_pairs = 0
+
+        for L in list(self.pending):
+            items = self.pending[L]
+            cap = self._cap_pairs(L, band)
+            while items:
+                total = sum(w.n_layers for _, w in items)
+                if (total < cap and len(items) <= MAX_GROUP_WINDOWS
+                        and not final):
+                    break                  # wait for more windows
+                group: List = []
+                pairs = 0
+                while items and len(group) < MAX_GROUP_WINDOWS:
+                    _, w = items[0]
+                    if group and pairs + w.n_layers > cap:
+                        break
+                    pairs += w.n_layers
+                    group.append(items.pop(0))
+                more = bool(items) or not final
+                self._dispatch(L, group, more_expected=more)
+            if not items:
+                del self.pending[L]
+
+    def _dispatch(self, L: int, group: List, more_expected: bool) -> None:
+        eng = self.eng
+        band = self.band
+        Lq = L + band
+        Lb = min(L + GROW, Lq)
+        max_nm = max(
+            int(np.max(w.lens + np.minimum(w.ends - w.begins + 65, Lb)))
+            for _, w in group)
+        max_n = max(w.max_layer_len for _, w in group)
+        steps, Lq2 = eng._sweep_geometry(Lq, max_nm, max_n)
+        bk = self.bucket_state.setdefault(
+            L, {"groups": 0, "steps": 0, "Lq2": 0})
+        bk["steps"] = max(bk["steps"], steps)
+        bk["Lq2"] = max(bk["Lq2"], Lq2)
+        two_stage = (eng.rounds > STAGE_A_ROUNDS
+                     and (more_expected or bk["groups"] > 0))
+        la = eng._launch_group(group, Lq, Lb)
+        la["geom"] = (Lq, Lb, steps, Lq2)
+        la["band"] = band
+        la["rounds"] = (min(eng.rounds, STAGE_A_ROUNDS) if two_stage
+                        else eng.rounds)
+        la["bucket"] = L
+        la["collect"] = two_stage
+        # resident bytes of this launch (packed pair inputs + per-window
+        # state + coalesced fetch arrays) — the in-flight budget's unit
+        la["bytes"] = (2 * Lq + 24) * la["B"] + 16 * Lb * la["nWp"]
+        eng._rounds(la, Lq, Lb, steps, Lq2)
+        bk["groups"] += 1
+        self.inflight.append(la)
+        self.inflight_bytes += la["bytes"]
+        while (len(self.inflight) > max(eng.num_batches, 1)
+               and self.inflight_bytes > MAX_INFLIGHT_BYTES):
+            self._finish_oldest()
+
+    def _finish_oldest(self) -> None:
+        la = self.inflight.pop(0)
+        self.inflight_bytes -= la["bytes"]
+        collect = (self.survivors.setdefault(la["bucket"], [])
+                   if la["collect"] else None)
+        self.eng._finish_group(la, self.trim, self.results,
+                               collect=collect)
+        self.fetched += 1
+        if self.progress is not None:
+            est = self.fetched + len(self.inflight) + 1
+            self.progress(self.fetched, est)
+
+    # -------------------------------------------------------------- drain
+
+    def finish(self, progress=None) -> List[bool]:
+        """Dispatch the partial groups, drain the pipeline, run stage B
+        per bucket and the CPU fallback; flags for every fed window."""
+        assert not self._done, "stream already finished"
+        self._done = True
+        eng = self.eng
+        if progress is not None:   # keep a callback set at stream() time
+            self.progress = progress
+        progress = self.progress
+        self._flush(final=True)
+        while self.inflight:
+            self._finish_oldest()
+        for L, surv in self.survivors.items():
+            if not surv:
+                continue
+            band = self.band
+            Lq = L + band
+            Lb = min(L + GROW, Lq)
+            bk = self.bucket_state[L]
+            eng._run_stage_b(surv, self.trim, self.results,
+                             Lq, Lb, bk["steps"], bk["Lq2"], band)
+        cpu_idx = [i for i, r in enumerate(self.results) if r is None]
+        if cpu_idx:
+            eng.stats["fallback_windows"] += len(cpu_idx)
+            if eng.fallback is None:
+                raise RuntimeError(
+                    f"{len(cpu_idx)} windows rejected, no CPU fallback")
+            flags_cpu = eng.fallback.run(
+                [self.windows[i] for i in cpu_idx], self.trim)
+            for i, f in zip(cpu_idx, flags_cpu):
+                self.results[i] = f
+        if progress is not None:
+            progress(1, 1)
+        eng._warn_dropped(self._stats_before)
+        return [bool(r) for r in self.results]
 
 
 class TpuPoaConsensus(PallasDispatchMixin):
@@ -784,18 +1202,44 @@ class TpuPoaConsensus(PallasDispatchMixin):
     def __init__(self, match: int, mismatch: int, gap: int, fallback=None,
                  max_depth: int = 200, band: int = BAND, rounds: int = 6,
                  mesh=None, ins_theta: float = 0.25, del_beta: float = 0.65,
-                 num_batches: int = 1, use_swar: bool = True):
+                 num_batches: int = 1, use_swar: bool = True,
+                 use_matmul_votes: Optional[bool] = None,
+                 use_ragged: Optional[bool] = None):
         self.fallback = fallback
+        # int8/i32 MXU vote reduction (on by default; ctor arg or
+        # RACON_TPU_MATMUL_VOTES=0 restores the f32-matmul + packed
+        # scatter for A/B): exact integer accumulation, no fold cap —
+        # ins_overflow is structurally 0 on this path
+        self.use_matmul_votes = (flags.get_bool("RACON_TPU_MATMUL_VOTES")
+                                 if use_matmul_votes is None
+                                 else use_matmul_votes)
+        # ragged window packing (on by default off-mesh; ctor arg or
+        # RACON_TPU_RAGGED=0 restores the single-geometry padded path):
+        # windows bucket by their own size, groups greedy-fill a fixed
+        # lane arena — the cudabatch batch-fill design (SURVEY §L3)
+        self.use_ragged = (flags.get_bool("RACON_TPU_RAGGED")
+                           if use_ragged is None else use_ragged)
         # device ceiling (companion to the K_INS/CH caps in the module
-        # docstring): the insertion accumulator is now a u32 pair per
-        # address (_accumulate_votes), so the old 9-bit-count cap (511)
-        # is gone; the binding limit is the f32 exactness of the column
-        # one-hot matmul — per-column weighted sums must stay < 2^24,
-        # and a vote carries at most 93 * 88 (phred x alpha) plus the
-        # backbone's 64 * 60, so depth 2047 is the largest exact depth:
-        # 2047 * 8184 + 3840 < 2^24. Deeper requests clamp here rather
-        # than silently losing integer exactness.
-        self.max_depth = min(max_depth, 2047)
+        # docstring): the insertion accumulator is exact on both paths
+        # (u32-pair scatter / int32 matmul), so the binding limit is the
+        # COLUMN vote reduction. On the f32 one-hot matmul per-column
+        # weighted sums must stay < 2^24 — a vote carries at most
+        # 93 * 88 (phred x alpha) plus the backbone's 64 * 60, making
+        # 2047 the largest exact depth (2047 * 8184 + 3840 < 2^24). The
+        # int8-limb matmul accumulates in int32, but the sums are still
+        # handed to the f32 consensus kernel; at the DEFAULT scores
+        # alpha is the constant 64, every weight (and the pre-scaled
+        # backbone votes) is a multiple of 64, and multiples of 64 are
+        # f32-exact up to 2^30 — 65535 * 5952 stays under that, so the
+        # cap lifts to a conservative 65535. Custom -m/-x/-g scores make
+        # alpha vary in [1, 88], sums are no longer 64-aligned, and the
+        # f32 handoff re-binds the cap at 2047. Deeper requests clamp
+        # rather than silently losing integer exactness.
+        default_scores = (match, mismatch, gap) == (
+            DEFAULT_MATCH, DEFAULT_MISMATCH, DEFAULT_GAP)
+        self.max_depth = min(max_depth,
+                             65535 if (self.use_matmul_votes
+                                       and default_scores) else 2047)
         self.band = band
         self.rounds = rounds
         self.mesh = mesh
@@ -836,28 +1280,98 @@ class TpuPoaConsensus(PallasDispatchMixin):
         self._shadow = sanitize.ShadowSampler()
         self._warmup = None
         # wavefront_steps: executed (post-gating) DP anti-diagonal steps,
-        # the honest numerator for utilization estimates (bench.py)
+        # the honest numerator for utilization estimates (bench.py);
+        # lanes_occupied/lanes_total/groups/group_windows: real packing
+        # efficiency of every dispatched pair arena (occupied lanes =
+        # sum of real layer lengths, total = B x Lq per launch) — the
+        # round-10 occupancy telemetry that replaces the coarse
+        # consensus_vpu_util_est
         self.stats = {"device_windows": 0, "fallback_windows": 0,
                       "dropped_layers": 0, "sweep_truncated": 0,
                       "ins_overflow": 0, "passthrough": 0,
-                      "stage_b_windows": 0, "wavefront_steps": 0}
+                      "stage_b_windows": 0, "wavefront_steps": 0,
+                      "lanes_occupied": 0, "lanes_total": 0,
+                      "groups": 0, "group_windows": 0}
+
+    def pack_metrics(self) -> dict:
+        """Derived occupancy view of :attr:`stats` (zeros before any
+        launch): ``pack_efficiency`` = occupied / total pair-arena
+        lanes, ``pad_fraction`` = 1 - efficiency, ``windows_per_group``
+        = mean windows per dispatched group."""
+        tot = self.stats.get("lanes_total", 0)
+        eff = self.stats.get("lanes_occupied", 0) / tot if tot else 0.0
+        grp = self.stats.get("groups", 0)
+        wpg = self.stats.get("group_windows", 0) / grp if grp else 0.0
+        return {"pack_efficiency": round(eff, 4),
+                "pad_fraction": round(1.0 - eff, 4) if tot else 0.0,
+                "windows_per_group": round(wpg, 2),
+                "groups": grp}
 
     # -------------------------------------------------------------- public
 
     def run(self, windows, trim: bool, progress=None) -> List[bool]:
+        """Consensus over a window batch. Default routing is the ragged
+        packer (:meth:`stream` — per-size-bucket geometry with greedy
+        arena fill); ``use_ragged=False`` / ``RACON_TPU_RAGGED=0`` or a
+        device mesh take the padded single-geometry path. Outputs are
+        bit-identical across the two (windows are independent and the
+        vote accumulation is exact at any grouping)."""
+        if self.use_ragged and self.mesh is None:
+            sess = self.stream(trim)
+            sess.feed(windows)
+            return sess.finish(progress=progress)
+        before = dict(self.stats)
+        out = self._run_padded(windows, trim, progress)
+        self._warn_dropped(before)
+        return out
+
+    def stream(self, trim: bool, band_hint: int = 0):
+        """Open a ragged streaming session (round 10): ``feed()`` packs
+        and **asynchronously dispatches** full groups as window ranges
+        arrive — host packing/fetch/emit overlaps device compute through
+        the in-flight launch pipeline — and ``finish()`` drains, runs
+        stage B and the CPU fallback, and returns the flags for every
+        fed window in feed order. The ``Polisher.run()`` bounded queue
+        feeds this directly, so the device never idles on the host
+        between window ranges (double-buffered dispatch). Returns None
+        when the ragged packer is unavailable (mesh runs, flag off) —
+        callers then fall back to per-batch :meth:`run` calls.
+
+        ``band_hint``: optional backbone-length upper bound used to
+        freeze the alignment band before the full window set has been
+        fed (the padded path derives band from the global live maximum;
+        a streaming caller that knows its window length passes it here
+        so both surfaces pick the same band)."""
+        if not self.use_ragged or self.mesh is not None:
+            return None
+        return _ConsensusStream(self, trim, band_hint)
+
+    def _warn_dropped(self, before: dict) -> None:
+        """One-line per-run visibility for silently dropped layers
+        (scale_stats.dropped_layers was 4943 at BENCH_r05 with no
+        warning): depth-cap drops and rejected layer alignments both
+        land in the counter."""
+        d = self.stats["dropped_layers"] - before.get("dropped_layers", 0)
+        if d > 0:
+            from ..utils.logger import warn
+            warn(f"consensus: {d} layer alignments dropped this run "
+                 f"(voting depth cap {self.max_depth} and/or rejected "
+                 f"alignments) — see consensus_stats.dropped_layers")
+
+    def _run_padded(self, windows, trim: bool, progress=None) -> List[bool]:
         results: List[Optional[bool]] = [None] * len(windows)
         works: List[_Work] = []
         for i, win in enumerate(windows):
-            if len(win.sequences) < 3:
-                win.consensus = win.sequences[0]
+            if win.layer_count + 1 < 3:
+                win.consensus = win.backbone
                 results[i] = False
                 self.stats["passthrough"] += 1
             else:
                 works.append((i, _Work(win, self.max_depth, self.stats)))
 
-        live = [(i, w) for i, w in works if len(w.layers) >= 2]
+        live = [(i, w) for i, w in works if w.n_layers >= 2]
         for i, w in works:
-            if len(w.layers) < 2:
+            if w.n_layers < 2:
                 results[i] = None  # CPU fallback
 
         if live:
@@ -867,8 +1381,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # windows whose layers exceed the pair buffer (or backbones the
             # backbone buffer) go to the CPU fallback via results[i] None
             live = [(i, w) for i, w in live
-                    if all(len(s) <= Lq for s, _, _, _ in w.layers)
-                    and len(w.backbone) <= Lb]
+                    if w.max_layer_len <= Lq and len(w.backbone) <= Lb]
 
         if live:
             # anti-diagonal sweep bound: longest real pair plus span-growth
@@ -876,18 +1389,19 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # a span that outgrows the slack drops that pair's votes for
             # the round, like a band escape)
             max_nm = max(
-                len(s) + min((e - b + 1) + 64, Lb)
-                for _, w in live for s, _, b, e in w.layers)
-            max_n = max(len(s) for _, w in live for s, _, _, _ in w.layers)
+                int(np.max(w.lens + np.minimum(w.ends - w.begins + 65,
+                                               Lb)))
+                for _, w in live)
+            max_n = max(w.max_layer_len for _, w in live)
             steps, Lq2 = self._sweep_geometry(Lq, max_nm, max_n)
             from ..parallel import partition_balanced
-            total_pairs = sum(len(w.layers) for _, w in live)
+            total_pairs = sum(w.n_layers for _, w in live)
             n_groups = max(self.num_batches,
                            -(-total_pairs // MAX_GROUP_PAIRS))
             if n_groups == 1:
                 groups = [list(live)]
             else:
-                bins = partition_balanced([len(w.layers) for _, w in live],
+                bins = partition_balanced([w.n_layers for _, w in live],
                                           n_groups)
                 groups = [[live[i] for i in b] for b in bins if b]
             # bounded pipeline: at most inflight_cap+1 groups'
@@ -1024,10 +1538,24 @@ class TpuPoaConsensus(PallasDispatchMixin):
         if self.mesh is not None or est_pairs <= 0:
             return None
         band, L, Lq, Lb = self._bucket_geometry(window_length)
+        if self.use_ragged:
+            # the ragged packer buckets by power-of-two lane widths and
+            # greedy-fills the lane arena — warm the dominant bucket's
+            # first-group shape
+            max_dev_L = (1 << 18) // (K_INS * CH) - GROW
+            L = 256
+            while L < max(256, min(window_length, max_dev_L)):
+                L = min(L * 2, max_dev_L)
+            Lq = L + band
+            Lb = min(L + GROW, Lq)
+            cap = _ConsensusStream._cap_pairs(L, band)
+        else:
+            cap = MAX_GROUP_PAIRS
         est_layer_len = min(est_layer_len or window_length + 64, Lq)
         max_nm = est_layer_len + min(est_layer_len + 64, Lb)
         steps, Lq2 = self._sweep_geometry(Lq, max_nm, est_layer_len)
-        n_groups = max(self.num_batches, -(-est_pairs // MAX_GROUP_PAIRS))
+        n_groups = max(1 if self.use_ragged else self.num_batches,
+                       -(-est_pairs // cap))
         B = 1
         while B < max(1, -(-est_pairs // n_groups)):
             B *= 2
@@ -1068,7 +1596,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
                     jnp.float32(self.del_beta), rounds=rounds,
                     n_windows=nWp, max_len=Lq, band=band, Lb=Lb,
                     K=K_INS, steps=steps, use_pallas=use_pallas,
-                    use_swar=sw, Lq2=Lq2, scores=self.scores)
+                    use_swar=sw, Lq2=Lq2, scores=self.scores,
+                    matmul_votes=self.use_matmul_votes)
                 jax.block_until_ready(out[10])
             except Exception as e:  # warm-up is an optimization, never fatal
                 from ..utils.logger import log_swallowed
@@ -1103,43 +1632,69 @@ class TpuPoaConsensus(PallasDispatchMixin):
         win_of = np.full(B, nWp - 1, np.int32)  # padding -> sink window
         real = np.zeros(B, bool)
 
-        # one pass of bookkeeping, then vectorized row fills: layer bytes
-        # are concatenated once and sliced back via a (rows x Lq) position
-        # grid — the per-layer Python loop this replaces dominated the
-        # pack at ~0.15 ms/layer
-        layers = [(wi, seq, qual, b, e, len(w.backbone))
-                  for wi, (_, w) in enumerate(items)
-                  for seq, qual, b, e in w.layers]
-        k = len(layers)
+        counts = np.array([w.n_layers for _, w in items], np.int64)
+        k = int(counts.sum())
         if k:
-            lens = np.array([len(t[1]) for t in layers], np.int32)
+            # per-pair metadata straight from the works' flat arrays —
+            # no per-layer Python loop in either storage mode
+            offs = np.zeros(len(items) + 1, np.int64)
+            np.cumsum(counts, out=offs[1:])
+            lens = np.concatenate([w.lens for _, w in items])
+            bb_len = np.repeat([len(w.backbone) for _, w in items], counts)
             n[:k] = lens
-            bg[:k] = np.minimum([t[3] for t in layers],
-                                np.array([t[5] for t in layers]) - 1)
-            ed[:k] = np.minimum([t[4] for t in layers],
-                                np.array([t[5] for t in layers]) - 1)
-            win_of[:k] = [t[0] for t in layers]
+            bg[:k] = np.minimum(np.concatenate(
+                [w.begins for _, w in items]), bb_len - 1)
+            ed[:k] = np.minimum(np.concatenate(
+                [w.ends for _, w in items]), bb_len - 1)
+            win_of[:k] = np.repeat(np.arange(len(items)), counts)
             real[:k] = True
 
-            cat = np.frombuffer(b"".join(t[1] for t in layers), np.uint8)
-            codes_cat = _CODE_LUT[cat]
-            starts = np.concatenate(([0], np.cumsum(lens)[:-1]))
-            pos = np.arange(Lq)[None, :]
-            valid = pos < lens[:, None]
-            src = starts[:, None] + np.minimum(pos, lens[:, None] - 1)
+            # columnar windows: ONE vectorized pool gather per store
+            # lands every layer's finished uint16 lanes (codes + phred
+            # weights were packed once at store build)
+            by_store = {}
+            legacy = []
+            for wi, (_, w) in enumerate(items):
+                if not w.n_layers:
+                    continue
+                if w.store is not None:
+                    by_store.setdefault(id(w.store), []).append(wi)
+                else:
+                    legacy.append(wi)
+            for wis in by_store.values():
+                store = items[wis[0]][1].store
+                rows = np.concatenate([items[wi][1].rows for wi in wis])
+                dest = np.concatenate(
+                    [np.arange(offs[wi], offs[wi + 1]) for wi in wis])
+                qpw[dest] = store.gather_qpw(rows, Lq)
 
-            qual_cat = np.frombuffer(
-                b"".join((t[2] if t[2] is not None else b"\x22" * len(t[1]))
-                         for t in layers), np.uint8)
-            # integral weights: phred-33 (clipped at 0 — a quality byte
-            # below '!' would otherwise wrap) or 1 for no-quality
-            weights = np.maximum(qual_cat[src].astype(np.int16) - 33, 0)
-            has_q = np.array([t[2] is not None for t in layers])
-            weights = np.where(has_q[:, None], weights, 1)
-            qpw[:k] = np.where(
-                valid,
-                (weights.astype(np.uint16) << 3) | codes_cat[src],
-                0).astype(np.uint16)
+            # hand-built windows (tests, benches): the round-7 join-and-
+            # LUT path over just their layers
+            if legacy:
+                lay = [(s, q) for wi in legacy
+                       for s, q, _, _ in items[wi][1].layers]
+                cat = np.frombuffer(b"".join(s for s, _ in lay), np.uint8)
+                codes_cat = _CODE_LUT[cat]
+                llens = np.array([len(s) for s, _ in lay], np.int64)
+                starts = np.concatenate(([0], np.cumsum(llens)[:-1]))
+                pos = np.arange(Lq)[None, :]
+                valid = pos < llens[:, None]
+                src = starts[:, None] + np.minimum(pos, llens[:, None] - 1)
+                qual_cat = np.frombuffer(
+                    b"".join((q if q is not None else b"\x22" * len(s))
+                             for s, q in lay), np.uint8)
+                # integral weights: phred-33 (clipped at 0 — a quality
+                # byte below '!' would otherwise wrap) or 1 for
+                # no-quality
+                weights = np.maximum(qual_cat[src].astype(np.int16) - 33, 0)
+                has_q = np.array([q is not None for _, q in lay])
+                weights = np.where(has_q[:, None], weights, 1)
+                dest = np.concatenate(
+                    [np.arange(offs[wi], offs[wi + 1]) for wi in legacy])
+                qpw[dest] = np.where(
+                    valid,
+                    (weights.astype(np.uint16) << 3) | codes_cat[src],
+                    0).astype(np.uint16)
 
         bcodes = np.zeros((nWp, Lb), np.uint8)
         bweights = np.zeros((nWp, Lb), np.float32)
@@ -1160,7 +1715,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         if overrides:
             off = 0
             for wi, (ri, w) in enumerate(items):
-                kw = len(w.layers)
+                kw = w.n_layers
                 st = overrides.get(ri)
                 if st is not None:
                     st_bc, st_bl, st_cov, st_ever, st_bg, st_ed = st
@@ -1188,10 +1743,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         if nd == 1:
             shards = [list(live)]
         else:
-            bins = partition_balanced([len(w.layers) for _, w in live], nd)
+            bins = partition_balanced([w.n_layers for _, w in live], nd)
             shards = [[live[i] for i in b] for b in bins]
 
-        max_pairs = max(sum(len(w.layers) for _, w in sh) for sh in shards)
+        max_pairs = max(sum(w.n_layers for _, w in sh) for sh in shards)
         max_wins = max(len(sh) for sh in shards)
         B = 1
         while B < max(max_pairs, 1):
@@ -1204,6 +1759,13 @@ class TpuPoaConsensus(PallasDispatchMixin):
                  for sh in shards]
         pair_np = [np.concatenate([p[0][a] for p in packs])
                    for a in range(6)]
+        # occupancy telemetry (round 10): real lane occupancy of this
+        # launch's pair arena — occupied = sum of real layer lengths,
+        # total = padded rows x the bucket's lane width
+        self.stats["lanes_occupied"] += int(pair_np[0][pair_np[3]].sum())
+        self.stats["lanes_total"] += int(pair_np[0].shape[0]) * Lq
+        self.stats["groups"] += 1
+        self.stats["group_windows"] += len(live)
         win_np = [np.concatenate([p[1][a] for p in packs])
                   for a in range(5)]
         # single-host: plain device puts; multi-host: every process packs
@@ -1313,13 +1875,15 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 *static, *state, theta, beta, rounds=rounds,
                 n_windows=launch["nWp"], max_len=Lq, band=band,
                 Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-                use_swar=use_swar, Lq2=Lq2, scores=self.scores)
+                use_swar=use_swar, Lq2=Lq2, scores=self.scores,
+                matmul_votes=self.use_matmul_votes)
         from ..parallel import sharded_refine_loop
         return sharded_refine_loop(
             self.mesh, static, state, theta, beta, rounds=rounds,
             n_windows_local=launch["nWp"], max_len=Lq, band=band,
             Lb=Lb, K=K_INS, steps=steps, use_pallas=use_pallas,
-            use_swar=use_swar, Lq2=Lq2, scores=self.scores)
+            use_swar=use_swar, Lq2=Lq2, scores=self.scores,
+            matmul_votes=self.use_matmul_votes)
 
     def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
                      Lq2, band) -> None:
@@ -1336,13 +1900,13 @@ class TpuPoaConsensus(PallasDispatchMixin):
         live = [(i, w) for i, w, _ in survivors]
         overrides = {i: st for i, _, st in survivors}
         self.stats["stage_b_windows"] += len(live)
-        total_pairs = sum(len(w.layers) for _, w in live)
+        total_pairs = sum(w.n_layers for _, w in live)
         n_groups = max(1, -(-total_pairs // MAX_GROUP_PAIRS))
         if n_groups == 1:
             groups = [live]
         else:
             from ..parallel import partition_balanced
-            bins = partition_balanced([len(w.layers) for _, w in live],
+            bins = partition_balanced([w.n_layers for _, w in live],
                                       n_groups)
             groups = [[live[i] for i in b] for b in bins if b]
         inflight = []
@@ -1464,7 +2028,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             off = 0  # pair-row offset within this shard's pack
             for wi, (i, w) in enumerate(sh):
                 row = s * nWp + wi
-                kw = len(w.layers)
+                kw = w.n_layers
                 p0 = s * B + off
                 off += kw
                 if (collect is not None and not conv_h[row]

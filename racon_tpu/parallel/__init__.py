@@ -162,7 +162,7 @@ def sharded_align(mesh: Mesh, qrp, tp, n, m, *, max_len: int, band: int,
 def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
                        max_len: int, band: int, Lb: int, K: int,
                        steps: int, use_pallas: bool, use_swar: bool,
-                       Lq2: int, scores):
+                       Lq2: int, scores, matmul_votes: bool = False):
     from ..ops.poa import refine_loop
 
     def local(n, qpw, win_of, real, bg, ed,
@@ -175,7 +175,8 @@ def _sharded_refine_fn(mesh: Mesh, rounds: int, n_windows_local: int,
                            n_windows=n_windows_local, max_len=max_len,
                            band=band, Lb=Lb, K=K, steps=steps,
                            use_pallas=use_pallas, use_swar=use_swar,
-                           Lq2=Lq2, scores=scores)
+                           Lq2=Lq2, scores=scores,
+                           matmul_votes=matmul_votes)
 
     spec = P(AXIS)
     return jax.jit(_shard_map(
@@ -187,7 +188,8 @@ def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
                         rounds: int, n_windows_local: int, max_len: int,
                         band: int, Lb: int, K: int, steps: int = 0,
                         use_pallas: bool = False, use_swar: bool = False,
-                        Lq2: int = 0, scores=(3, -5, -4)):
+                        Lq2: int = 0, scores=(3, -5, -4),
+                        matmul_votes: bool = False):
     """A group's whole refinement loop over a co-sharded batch, one
     dispatch (the shard-local body is ``refine_loop``'s fori over
     ``refine_round``).
@@ -208,5 +210,5 @@ def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
     """
     fn = _sharded_refine_fn(mesh, rounds, n_windows_local, max_len, band,
                             Lb, K, steps, use_pallas, use_swar, Lq2,
-                            scores)
+                            scores, matmul_votes)
     return fn(*static, *state, ins_theta, del_beta)

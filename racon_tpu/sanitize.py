@@ -8,7 +8,12 @@ Four independent detectors, all off unless the flag is set:
   (:func:`should_shadow` / :func:`shadow_compare`).  The static guards
   (``swar.swar_fits`` + the kernels' trace-time assert) make a real
   int16 overflow unreachable *when they are in place*; the shadow path
-  is the net that catches the day someone loosens them.
+  is the net that catches the day someone loosens them.  The consensus
+  shadow re-dispatches WHOLE launches from their pre-round state
+  (``TpuPoaConsensus._dispatch_rounds``), so it follows whatever layout
+  the launch used — ragged per-bucket geometry and int8-matmul vote
+  groups shadow exactly like padded single-geometry ones (the ragged
+  parity suite re-runs under the sanitizer in CI to prove it).
 - **Kernel-output canaries** — cheap host-side invariant checks on every
   fetched chunk/group (:func:`check_aligner_canaries`,
   :func:`check_consensus_canaries`): a wrapped int16 lane surfaces as a

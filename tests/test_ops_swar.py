@@ -333,12 +333,67 @@ def test_insertion_accumulator_deep_window_regression():
 
 def test_max_depth_cap_lifted_past_511():
     """The 511 voting-depth clamp existed only to protect the 9-bit
-    count field; the widened accumulator moves the ceiling to the f32
-    matmul-exactness bound (2047)."""
+    count field; the widened accumulator moved the ceiling to the f32
+    matmul-exactness bound (2047), and the round-10 int8/int32 matmul
+    vote path removes that bound at the default scores — the cap moves
+    to a conservative 65535 (explicit use_matmul_votes so the test is
+    independent of the RACON_TPU_MATMUL_VOTES env)."""
     from racon_tpu.ops.poa import TpuPoaConsensus
 
-    assert TpuPoaConsensus(3, -5, -4, max_depth=4096).max_depth == 2047
-    assert TpuPoaConsensus(3, -5, -4, max_depth=200).max_depth == 200
+    assert TpuPoaConsensus(3, -5, -4, max_depth=4096,
+                           use_matmul_votes=False).max_depth == 2047
+    assert TpuPoaConsensus(3, -5, -4, max_depth=4096,
+                           use_matmul_votes=True).max_depth == 4096
+    assert TpuPoaConsensus(3, -5, -4, max_depth=10 ** 6,
+                           use_matmul_votes=True).max_depth == 65535
+    assert TpuPoaConsensus(3, -5, -4, max_depth=200,
+                           use_matmul_votes=True).max_depth == 200
+    # custom -m/-x/-g: vote sums lose 64-alignment, the f32 handoff to
+    # the consensus kernel re-binds the cap at 2047 even on matmul votes
+    assert TpuPoaConsensus(4, -5, -4, max_depth=4096,
+                           use_matmul_votes=True).max_depth == 2047
+
+
+def test_matmul_votes_deep_address_regression():
+    """Round 10: >= 4096 votes on ONE address through the int8-matmul
+    vote path accumulate exactly, bit-compared against an integer numpy
+    reference — the per-address weighted sum here (4608 x 5760 ≈ 26.5M)
+    is past the 2^24 f32-exactness bound that set the old 2047 depth
+    cap, so only an exact integer reduction can pass. Extends the
+    round-6 600+640-vote test (which stayed under the f32 bound)."""
+    from racon_tpu.ops.poa import CH, _accumulate_votes
+
+    L, K, nW, band = 64, 4, 2, 64
+    B, S = 4608, 16
+    col_addr = 5 * CH + 1             # column 5 (bg=0, span 6), base C
+    ins_addr = (L + 3 * K + 1) * CH + 2  # junction 3, slot 1, base G
+    idx = np.full((B, S), L * (1 + K) * CH, np.int32)
+    idx[:, 0] = col_addr
+    idx[:, 1] = ins_addr
+    w = np.zeros((B, S), np.int32)
+    w[:, 0] = 90                      # x alpha 64 -> 5760 per vote
+    w[:, 1] = 90
+    ok = np.ones(B, bool)
+    win_of = np.zeros(B, np.int32)
+    span_m = np.full(B, 6, np.int32)  # one col step -> lands column 5
+    n = np.full(B, 2, np.int32)
+    score = np.ones(B, np.int32)
+    args = [jnp.asarray(a) for a in
+            (idx, w, ok, win_of, span_m, np.zeros(B, np.int32), n,
+             score)]
+    weighted, unweighted, ovf = _accumulate_votes(
+        *args, n_windows=nW, L=L, K=K, band=band, matmul_votes=True)
+    expect = np.int64(B) * 90 * 64
+    assert expect > (1 << 24)         # past the old f32 exactness bound
+    for addr in (col_addr, ins_addr):
+        assert int(np.asarray(weighted)[0, addr]) == expect
+        assert int(np.asarray(unweighted)[0, addr]) == B
+    assert int(ovf) == 0
+    # the unweighted counts (exact ints on both paths) must agree with
+    # the scatter/f32 reference emitter bit-for-bit
+    _, unw_ref, _ = _accumulate_votes(
+        *args, n_windows=nW, L=L, K=K, band=band, matmul_votes=False)
+    assert np.array_equal(np.asarray(unweighted), np.asarray(unw_ref))
 
 
 # --------------------------------------------------------------- warm-up
