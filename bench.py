@@ -411,6 +411,10 @@ def bench_pipeline():
             for path, blob in ((rp, reads), (pp, paf), (cp, contigs)):
                 with open(path, "wb") as f:
                     f.write(blob)
+            # run boundary: each bench leg reports its own registry
+            # numbers (retrace below), not the previous leg's
+            from racon_tpu.obs import metrics as obs_metrics
+            obs_metrics.clear_run()
             t0 = _time.perf_counter()
             p = create_polisher(rp, pp, cp, num_threads=8,
                                 aligner_backend=backend,
@@ -433,10 +437,12 @@ def bench_pipeline():
         for eng in (p.aligner, p.consensus):
             for k, v in getattr(eng, "stats", {}).items():
                 stats[k] = stats.get(k, 0) + v
-        # per-phase jit-compile churn (PhaseRetraceBudget records deltas
-        # whether or not the sanitizer is armed — ROADMAP r8 follow-up)
-        from racon_tpu.sanitize import PhaseRetraceBudget
-        retrace = dict(PhaseRetraceBudget.last_deltas)
+        # per-phase jit-compile churn (PhaseRetraceBudget publishes the
+        # deltas to the obs metrics registry whether or not the
+        # sanitizer is armed — bench reads the one registry like the
+        # heartbeat and the run report do)
+        from racon_tpu.obs import metrics as obs_metrics
+        retrace = obs_metrics.group("retrace.")
         # quality gate on a truth-prefix slice (coordinates drift with
         # indels, so compare a bounded prefix with the full Myers NW)
         probe = min(100_000, len(truths[0]))

@@ -46,15 +46,30 @@ RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
 # round trip — before anything slow runs
 python -m tools.analysis --quiet racon_tpu/exec
 python -m pytest tests/test_exec.py -q
+# observability shard (fail-fast, round 11): graftlint gate over the
+# obs package and every span-instrumented producer (span-discipline +
+# the 5 older rules), then the tracer/registry/report suite — trace
+# schema, RACON_TPU_TRACE byte-identity, disabled-span overhead guard,
+# run-report schema validation for CLI and exec runs
+python -m tools.analysis --quiet racon_tpu/obs racon_tpu/core \
+  racon_tpu/exec racon_tpu/utils racon_tpu/cli.py racon_tpu/sanitize.py
+python -m pytest tests/test_obs.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
-  --ignore=tests/test_exec.py --ignore=tests/test_ragged.py
+  --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
+  --ignore=tests/test_obs.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh
 DATA=/root/reference/test/data
-python -m racon_tpu -t 8 \
+# golden byte-diff WITH tracing on: --trace must not perturb a single
+# output byte, and the emitted run_report.json must validate against
+# its schema (the trace itself is sanity-checked for JSON-ness)
+python -m racon_tpu -t 8 --trace /tmp/ci_cpu_trace.json \
+  --run-report /tmp/ci_cpu_report.json \
   "$DATA/sample_reads.fastq.gz" "$DATA/sample_overlaps.paf.gz" \
   "$DATA/sample_layout.fasta.gz" > /tmp/ci_cpu_out.fasta
 cmp /tmp/ci_cpu_out.fasta tests/data/golden_lambda_fastq_paf.fasta
-echo "cpu golden: OK"
+python -m racon_tpu.obs --check /tmp/ci_cpu_report.json
+python -c "import json; json.load(open('/tmp/ci_cpu_trace.json'))"
+echo "cpu golden (traced): OK"

@@ -11,9 +11,11 @@ accelerator knobs mirror the reference's CUDA flags with TPU naming:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+import time
 
-from . import __version__
+from . import __version__, flags, obs
 from .core.polisher import PolisherType, create_polisher
 
 
@@ -66,6 +68,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler trace of the polishing run "
                         "to DIR (view with TensorBoard / xprof; the TPU "
                         "analog of the reference's nvprof hooks)")
+    # observability (racon_tpu.obs): pipeline span traces + run reports
+    p.add_argument("--trace", metavar="FILE", default=None,
+                   help="write a Chrome trace-event JSON of the run's "
+                        "pipeline spans (parse/align/decode/build/"
+                        "consensus/stitch, queue waits, per-shard "
+                        "tracks) to FILE — load it in Perfetto; also "
+                        "emits run_report.json next to FILE unless "
+                        "--run-report names one (RACON_TPU_TRACE is the "
+                        "env equivalent; output bytes are identical "
+                        "with tracing on)")
+    p.add_argument("--run-report", metavar="FILE", default=None,
+                   help="write the schema-versioned machine-readable "
+                        "run report (per-phase wall clock, dispatch-vs-"
+                        "fetch split, pack occupancy, retrace/queue "
+                        "metrics, per-shard rows) to FILE "
+                        "(RACON_TPU_RUN_REPORT is the env equivalent)")
     # streaming shard runner (racon_tpu.exec): bounded-memory runs with
     # checkpoint/resume; output stays byte-identical to a single-shot run
     p.add_argument("--shards", type=int, default=0, metavar="N",
@@ -110,7 +128,39 @@ def _preprocess_argv(argv):
     return out
 
 
-def _run_sharded(args) -> int:
+def _obs_paths(args):
+    """(trace_path, report_path) from the CLI flags merged with their
+    env-flag equivalents; ``--trace`` without ``--run-report`` defaults
+    the report next to the trace file (one switch yields the whole
+    observability artifact set)."""
+    trace_path = args.trace or flags.get_str("RACON_TPU_TRACE") or None
+    report_path = (args.run_report
+                   or flags.get_str("RACON_TPU_RUN_REPORT") or None)
+    if trace_path and report_path is None:
+        report_path = os.path.join(
+            os.path.dirname(os.path.abspath(trace_path)),
+            "run_report.json")
+    return trace_path, report_path
+
+
+def _finish_obs(trace_path, report_path, kind, argv, t_start, t0,
+                phases=None, shards=None) -> None:
+    """Export the requested observability artifacts (also called on the
+    error paths: a trace of a crashed run is exactly the data needed to
+    debug it). The trace exports FIRST so its ring-overflow gauge
+    (``trace.dropped_events``) lands in the report's snapshot."""
+    from .obs import report as obs_report
+    if trace_path:
+        obs.trace.export(trace_path)
+    if report_path:
+        rep = obs_report.build_report(
+            kind, argv=argv, started_unix=t_start,
+            wall_s=time.perf_counter() - t0, phases=phases,
+            shards=shards)
+        obs_report.write_report(report_path, rep)
+
+
+def _run_sharded(args, argv, trace_path, report_path, t_start, t0) -> int:
     """Route through the streaming shard runner (racon_tpu.exec)."""
     from .exec import ShardRunner, parse_ram
 
@@ -137,7 +187,10 @@ def _run_sharded(args) -> int:
         runner.run(sys.stdout.buffer)
     except (ValueError, RuntimeError, OSError) as e:
         print(f"[racon::] error: {e}", file=sys.stderr)
+        _finish_obs(trace_path, report_path, "exec", argv, t_start, t0)
         return 1
+    _finish_obs(trace_path, report_path, "exec", argv, t_start, t0,
+                shards=runner.summary.get("shards"))
     return 0
 
 
@@ -146,8 +199,14 @@ def main(argv=None) -> int:
         argv = sys.argv[1:]
     args = build_parser().parse_args(_preprocess_argv(list(argv)))
 
+    trace_path, report_path = _obs_paths(args)
+    obs.begin(trace_path, report_path)
+    t_start = time.time()
+    t0 = time.perf_counter()
+
     if args.shards or args.max_ram or args.resume or args.shard_dir:
-        return _run_sharded(args)
+        return _run_sharded(args, list(argv), trace_path, report_path,
+                            t_start, t0)
 
     try:
         polisher = create_polisher(
@@ -167,6 +226,8 @@ def main(argv=None) -> int:
         )
     except (ValueError, ImportError) as e:
         print(f"[racon::createPolisher] error: {e}", file=sys.stderr)
+        _finish_obs(trace_path, report_path, "cli", list(argv), t_start,
+                    t0)
         return 1
 
     try:
@@ -174,6 +235,16 @@ def main(argv=None) -> int:
         if args.profile:
             import jax
             trace = jax.profiler.trace(args.profile)
+            # --profile wraps the WHOLE run in jax.profiler.trace; a
+            # concurrent RACON_TPU_JAX_PROFILE bracket around the polish
+            # phase would try to start a second trace inside it, which
+            # the jax profiler rejects mid-run — the wider --profile
+            # wins and the env hook is disarmed with a note
+            if flags.get_str("RACON_TPU_JAX_PROFILE"):
+                print("[racon::] note: --profile supersedes "
+                      "RACON_TPU_JAX_PROFILE (nested jax profiler "
+                      "sessions are not supported)", file=sys.stderr)
+                os.environ["RACON_TPU_JAX_PROFILE"] = ""
         else:
             trace = contextlib.nullcontext()
         with trace:
@@ -183,12 +254,16 @@ def main(argv=None) -> int:
             polished = polisher.run(not args.include_unpolished)
     except (ValueError, RuntimeError, OSError) as e:
         print(f"[racon::] error: {e}", file=sys.stderr)
+        _finish_obs(trace_path, report_path, "cli", list(argv), t_start,
+                    t0, phases=dict(polisher.timings))
         return 1
 
     out = sys.stdout.buffer
     for seq in polished:
         out.write(b">" + seq.name + b"\n" + seq.data + b"\n")
     out.flush()
+    _finish_obs(trace_path, report_path, "cli", list(argv), t_start, t0,
+                phases=dict(polisher.timings))
     return 0
 
 

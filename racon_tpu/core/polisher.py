@@ -47,8 +47,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .. import flags, sanitize
+from .. import flags, obs, sanitize
 from ..io import parsers
+from ..obs import metrics
 from ..utils.logger import Logger
 from .backends import make_aligner, make_consensus
 from .overlap import Overlap, decode_breaking_points_batch
@@ -168,7 +169,8 @@ class Polisher:
             return
         overlaps = self._initialize_core()
         self.logger.log()
-        self._assemble_layers(overlaps)
+        with obs.span("build.windows"):
+            self._assemble_layers(overlaps)
         self.logger.log("[racon_tpu::Polisher::initialize] "
                         "transformed data into windows")
 
@@ -181,9 +183,10 @@ class Polisher:
         log.log()
         t_parse = time.perf_counter()
 
-        tparse = parsers.sequence_parser_for(self.target_path)
-        self.sequences = [Sequence(r.name, r.data, r.quality)
-                          for r in tparse(self.target_path)]
+        with obs.span("parse.targets"):
+            tparse = parsers.sequence_parser_for(self.target_path)
+            self.sequences = [Sequence(r.name, r.data, r.quality)
+                              for r in tparse(self.target_path)]
         self.targets_size = len(self.sequences)
         if self.targets_size == 0:
             raise ValueError("empty target sequences set")
@@ -201,31 +204,34 @@ class Polisher:
         log.log("[racon_tpu::Polisher::initialize] loaded target sequences")
         log.log()
 
-        sparse = parsers.sequence_parser_for(self.sequences_path)
-        raw_index = 0
-        total_len = 0
-        for rec in sparse(self.sequences_path):
-            seq = Sequence(rec.name, rec.data, rec.quality)
-            total_len += len(seq.data)
-            tkey = seq.name + b"t"
-            tid = name_to_id.get(tkey)
-            if tid is not None:
-                existing = self.sequences[tid]
-                if (len(seq.data) != len(existing.data) or
-                        len(seq.quality or b"") != len(existing.quality or b"")):
-                    raise ValueError(
-                        f"duplicate sequence {seq.name!r} with unequal data")
-                name_to_id[seq.name + b"q"] = tid
-                id_to_id[raw_index << 1 | 0] = tid
-            else:
-                self.sequences.append(seq)
-                pos = len(self.sequences) - 1
-                name_to_id[seq.name + b"q"] = pos
-                id_to_id[raw_index << 1 | 0] = pos
-                has_name.append(False)
-                has_data.append(False)
-                has_reverse.append(False)
-            raw_index += 1
+        with obs.span("parse.reads"):
+            sparse = parsers.sequence_parser_for(self.sequences_path)
+            raw_index = 0
+            total_len = 0
+            for rec in sparse(self.sequences_path):
+                seq = Sequence(rec.name, rec.data, rec.quality)
+                total_len += len(seq.data)
+                tkey = seq.name + b"t"
+                tid = name_to_id.get(tkey)
+                if tid is not None:
+                    existing = self.sequences[tid]
+                    if (len(seq.data) != len(existing.data) or
+                            len(seq.quality or b"")
+                            != len(existing.quality or b"")):
+                        raise ValueError(
+                            f"duplicate sequence {seq.name!r} with "
+                            f"unequal data")
+                    name_to_id[seq.name + b"q"] = tid
+                    id_to_id[raw_index << 1 | 0] = tid
+                else:
+                    self.sequences.append(seq)
+                    pos = len(self.sequences) - 1
+                    name_to_id[seq.name + b"q"] = pos
+                    id_to_id[raw_index << 1 | 0] = pos
+                    has_name.append(False)
+                    has_data.append(False)
+                    has_reverse.append(False)
+                raw_index += 1
 
         if raw_index == 0:
             raise ValueError("empty sequences set")
@@ -241,16 +247,18 @@ class Polisher:
         log.log("[racon_tpu::Polisher::initialize] loaded sequences")
         log.log()
 
-        oparse = parsers.overlap_parser_for(self.overlaps_path)
-        overlaps: List[Overlap] = []
-        for rec in oparse(self.overlaps_path):
-            o = Overlap.from_record(rec)
-            o.transmute(self.sequences, name_to_id, id_to_id)
-            if o.is_valid:
-                overlaps.append(o)
+        with obs.span("parse.overlaps"):
+            oparse = parsers.overlap_parser_for(self.overlaps_path)
+            overlaps: List[Overlap] = []
+            for rec in oparse(self.overlaps_path):
+                o = Overlap.from_record(rec)
+                o.transmute(self.sequences, name_to_id, id_to_id)
+                if o.is_valid:
+                    overlaps.append(o)
 
-        if not self.prefiltered_overlaps:
-            overlaps = self._filter_overlaps(overlaps)
+        with obs.span("overlap.filter"):
+            if not self.prefiltered_overlaps:
+                overlaps = self._filter_overlaps(overlaps)
         if not overlaps:
             raise ValueError("empty overlap set")
 
@@ -288,17 +296,19 @@ class Polisher:
         # LUT-take + flip (``sequence.py``), which releases the GIL on
         # real read lengths, so a thread pool parallelizes it (chunked —
         # per-item futures cost more than most transmutes)
-        if self.num_threads > 1 and len(self.sequences) > 64:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(self.num_threads) as pool:
-                list(pool.map(
-                    lambda iv: iv[1].transmute(has_name[iv[0]],
-                                               has_data[iv[0]],
-                                               has_reverse[iv[0]]),
-                    enumerate(self.sequences), chunksize=64))
-        else:
-            for i, seq in enumerate(self.sequences):
-                seq.transmute(has_name[i], has_data[i], has_reverse[i])
+        with obs.span("transmute"):
+            if self.num_threads > 1 and len(self.sequences) > 64:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(self.num_threads) as pool:
+                    list(pool.map(
+                        lambda iv: iv[1].transmute(has_name[iv[0]],
+                                                   has_data[iv[0]],
+                                                   has_reverse[iv[0]]),
+                        enumerate(self.sequences), chunksize=64))
+            else:
+                for i, seq in enumerate(self.sequences):
+                    seq.transmute(has_name[i], has_data[i],
+                                  has_reverse[i])
 
         self.timings["parse_s"] = round(time.perf_counter() - t_parse, 3)
 
@@ -308,7 +318,8 @@ class Polisher:
         # leaves self.windows empty, so the double-init guard stays
         # accurate and the polisher is cleanly re-initializable
         t_bb = time.perf_counter()
-        self._build_backbone_windows()
+        with obs.span("build.backbone"):
+            self._build_backbone_windows()
         self._backbone_s = time.perf_counter() - t_bb
         # meaningful only for run(): layer-assembly wall hidden under the
         # consensus engine (the split surface overlaps nothing)
@@ -355,25 +366,30 @@ class Polisher:
         # regression this budget catches (no-op unless RACON_TPU_SANITIZE).
         # Scoped to the aligner kernel modules so the background
         # consensus warm-up thread's compiles are not charged here.
-        with sanitize.PhaseRetraceBudget(
-                "align", prefixes=("racon_tpu.ops.nw",
-                                   "racon_tpu.ops.pallas_nw",
-                                   "racon_tpu.parallel")):
+        with obs.span("align", pairs=len(need)), \
+                sanitize.PhaseRetraceBudget(
+                    "align", prefixes=("racon_tpu.ops.nw",
+                                       "racon_tpu.ops.pallas_nw",
+                                       "racon_tpu.parallel")):
             self._align_need(need, log, msg)
         self.timings["align_s"] = round(time.perf_counter() - t_align, 3)
 
         t_decode = time.perf_counter()
-        todo = [o for o in overlaps if o.breaking_points is None]
-        if todo:
-            arrs = decode_breaking_points_batch(
-                [o.cigar or "" for o in todo],
-                [o.q_length - o.q_end if o.strand else o.q_begin
-                 for o in todo],
-                [o.t_begin for o in todo], [o.t_end for o in todo],
-                self.window_length, self.num_threads)
-            for o, arr in zip(todo, arrs):
-                o.breaking_points = arr
-                o.cigar = None
+        # the span covers the whole host decode phase — zero-length on
+        # the device path, where breaking points came off the chip as
+        # columnar rows inside align.fetch
+        with obs.span("bp.decode"):
+            todo = [o for o in overlaps if o.breaking_points is None]
+            if todo:
+                arrs = decode_breaking_points_batch(
+                    [o.cigar or "" for o in todo],
+                    [o.q_length - o.q_end if o.strand else o.q_begin
+                     for o in todo],
+                    [o.t_begin for o in todo], [o.t_end for o in todo],
+                    self.window_length, self.num_threads)
+                for o, arr in zip(todo, arrs):
+                    o.breaking_points = arr
+                    o.cigar = None
         self.timings["bp_decode_s"] = round(
             time.perf_counter() - t_decode, 3)
         self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
@@ -570,10 +586,11 @@ class Polisher:
         # packers gather their lane blocks straight from the pool
         from .layers import LayerStore
         t_store = time.thread_time()
-        store = LayerStore.build(
-            data_refs, qual_refs, pair_ov[order], q_first[order],
-            q_endx[order], sorted_win, layer_begin[order],
-            layer_end[order], n_win)
+        with obs.span("build.store", rows=int(order.size)):
+            store = LayerStore.build(
+                data_refs, qual_refs, pair_ov[order], q_first[order],
+                q_endx[order], sorted_win, layer_begin[order],
+                layer_end[order], n_win)
         self.timings["layer_store_s"] = round(
             time.thread_time() - t_store, 3)
         t_append = time.thread_time()
@@ -660,14 +677,20 @@ class Polisher:
         log.log()
 
         msg = "[racon_tpu::Polisher::polish] generating consensus"
-        with sanitize.PhaseRetraceBudget(
-                "consensus", prefixes=("racon_tpu.ops.poa",
-                                       "racon_tpu.ops.pallas_nw",
-                                       "racon_tpu.parallel")):
+        # RACON_TPU_JAX_PROFILE brackets exactly the polish phase in
+        # jax.profiler.trace so XLA device activity lines up with the
+        # host spans (nullcontext when unset)
+        with obs.span("consensus", windows=len(self.windows)), \
+                obs.jax_profile(), \
+                sanitize.PhaseRetraceBudget(
+                    "consensus", prefixes=("racon_tpu.ops.poa",
+                                           "racon_tpu.ops.pallas_nw",
+                                           "racon_tpu.parallel")):
             polished_flags = self.consensus.run(
                 self.windows, self.trim,
                 progress=lambda d, t: log.bar_to(msg, d, t))
-        return self._stitch(polished_flags, drop_unpolished_sequences)
+        with obs.span("stitch"):
+            return self._stitch(polished_flags, drop_unpolished_sequences)
 
     def run(self, drop_unpolished_sequences: bool = True) -> List[Sequence]:
         """Fused initialize + polish with the two phases pipelined: the
@@ -711,14 +734,22 @@ class Polisher:
         def emit_range(a, b):
             if watchdog is not None:
                 watchdog.beat()
-            ranges.put((a, b))
+            t_put = time.perf_counter()
+            with obs.span("queue.put"):
+                ranges.put((a, b))
+            # registry: bounded-queue health for the heartbeat/report
+            # (producer blocking time = init outrunning the consensus)
+            metrics.add_time("queue.producer_wait_s",
+                             time.perf_counter() - t_put)
+            metrics.set_gauge("queue.depth", ranges.qsize())
 
         def produce():
             try:
                 t_cpu = time.thread_time()
-                self._assemble_layers(
-                    overlaps, emit=emit_range,
-                    chunk_windows=chunk_windows)
+                with obs.span("build.windows"):
+                    self._assemble_layers(
+                        overlaps, emit=emit_range,
+                        chunk_windows=chunk_windows)
                 # re-record with the producer's CPU time: its wall-clock
                 # stretches under GIL sharing with the consensus engine,
                 # which would overstate both the build cost and the
@@ -749,14 +780,20 @@ class Polisher:
         sess_tried = False
         fed_ranges: List = []
         try:
-            with sanitize.PhaseRetraceBudget(
-                "consensus", prefixes=("racon_tpu.ops.poa",
-                                       "racon_tpu.ops.pallas_nw",
-                                       "racon_tpu.parallel")):
+            with obs.span("consensus", windows=n_win), \
+                    obs.jax_profile(), \
+                    sanitize.PhaseRetraceBudget(
+                        "consensus", prefixes=("racon_tpu.ops.poa",
+                                               "racon_tpu.ops.pallas_nw",
+                                               "racon_tpu.parallel")):
                 while True:
                     t_get = time.perf_counter()
-                    item = ranges.get()
-                    queue_wait += time.perf_counter() - t_get
+                    with obs.span("queue.get"):
+                        item = ranges.get()
+                    dt_get = time.perf_counter() - t_get
+                    queue_wait += dt_get
+                    metrics.add_time("queue.consumer_wait_s", dt_get)
+                    metrics.set_gauge("queue.depth", ranges.qsize())
                     if watchdog is not None:
                         watchdog.beat()
                     if item is None:
@@ -778,14 +815,19 @@ class Polisher:
                             sess = stream_f(trim=self.trim,
                                             band_hint=band_hint)
                         if sess is not None:
-                            sess.feed(self.windows[a:b])
+                            with obs.span("consensus.feed",
+                                          windows=b - a):
+                                sess.feed(self.windows[a:b])
                             fed_ranges.append((a, b))
                         else:
-                            polished[a:b] = self.consensus.run(
-                                self.windows[a:b], self.trim)
+                            with obs.span("consensus.run",
+                                          windows=b - a):
+                                polished[a:b] = self.consensus.run(
+                                    self.windows[a:b], self.trim)
                     log.bar_to(msg, b, n_win)
                 if sess is not None:
-                    flags_all = sess.finish()
+                    with obs.span("consensus.finish"):
+                        flags_all = sess.finish()
                     pos = 0
                     for a, b in fed_ranges:
                         polished[a:b] = flags_all[pos:pos + (b - a)]
@@ -826,7 +868,8 @@ class Polisher:
         # writes inside the progress bar
         log.log("[racon_tpu::Polisher::initialize] "
                 "transformed data into windows")
-        return self._stitch(polished, drop_unpolished_sequences)
+        with obs.span("stitch"):
+            return self._stitch(polished, drop_unpolished_sequences)
 
     def _stitch(self, polished_flags: List[bool],
                 drop_unpolished_sequences: bool) -> List[Sequence]:

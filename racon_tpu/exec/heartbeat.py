@@ -1,15 +1,22 @@
-"""Long-run progress heartbeat: shard i/N, Mbp/s, peak RSS, jit-retrace
-counters.
+"""Long-run progress heartbeat: shard i/N, Mbp/s, peak RSS, pack
+occupancy, queue health and jit-retrace counters.
 
 A 100 Mbp+ polish runs for hours; the per-stage progress bars only show
 the *current* shard. The heartbeat thread prints one self-contained line
 every ``RACON_TPU_HEARTBEAT_S`` seconds (0 disables the periodic timer),
 and the runner also emits one at every shard completion, so logs from
-killed runs always end with an accurate position. Retrace counters come
-from :class:`racon_tpu.sanitize.PhaseRetraceBudget`, which records
-per-phase jit-compile deltas whether or not the sanitizer is armed — a
-shard that suddenly recompiles per chunk shows up here long before it
-shows up in wall-clock.
+killed runs always end with an accurate position.
+
+Every telemetry field is read from the ONE process-wide metrics
+registry (:mod:`racon_tpu.obs.metrics`): pack occupancy from the
+``consensus.*`` counters the device engine publishes per launch,
+bounded-queue depth/stall from the ``queue.*`` metrics the pipelined
+``Polisher.run()`` publishes, and per-phase jit-retrace deltas from the
+``retrace.*`` gauges :class:`racon_tpu.sanitize.PhaseRetraceBudget`
+records whether or not the sanitizer is armed — the heartbeat carries
+no plumbing of its own, so a shard that suddenly recompiles per chunk
+(or a queue that stalls) shows up here long before it shows up in
+wall-clock.
 """
 
 from __future__ import annotations
@@ -19,22 +26,39 @@ import threading
 import time
 from typing import Optional
 
-from .. import flags, sanitize
-
-
-def peak_rss_bytes() -> int:
-    """Lifetime peak RSS of this process (ru_maxrss is KiB on Linux,
-    bytes on macOS)."""
-    import resource
-    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-    return rss if sys.platform == "darwin" else rss * 1024
+from .. import flags
+from ..obs import metrics
+from ..obs.metrics import peak_rss_bytes  # noqa: F401  (re-export: the
+#   canonical implementation moved into the obs registry module; bench,
+#   rampler and the runner keep importing it from here)
 
 
 def retrace_summary() -> str:
-    deltas = sanitize.PhaseRetraceBudget.last_deltas
+    deltas = metrics.group("retrace.")
     if not deltas:
         return "-"
     return ",".join(f"{k}={v}" for k, v in sorted(deltas.items()))
+
+
+def pack_summary_str() -> str:
+    """Real packing occupancy of the consensus pair arenas (round 10):
+    occupied/total lanes and mean windows per dispatched group, derived
+    from the registry counters (``-`` before any launch)."""
+    pack = metrics.pack_summary()
+    if not pack["groups"]:
+        return "-"
+    return (f"{pack['pack_efficiency']:.2f}eff,"
+            f"{pack['windows_per_group']:.0f}w/g,"
+            f"{pack['groups']}g")
+
+
+def queue_summary_str() -> str:
+    """Bounded init->polish queue health: current depth plus cumulative
+    producer/consumer stall seconds (``-`` before any pipelined run)."""
+    q = metrics.queue_summary()
+    if not q["stall_s"] and not q["depth"]:
+        return "-"
+    return f"d={int(q['depth'])},stall={q['stall_s']:.1f}s"
 
 
 class Heartbeat:
@@ -48,7 +72,6 @@ class Heartbeat:
         self._done = 0
         self._mbp = 0.0
         self._phase = "indexing"
-        self._pack: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -68,8 +91,7 @@ class Heartbeat:
 
     def update(self, done: Optional[int] = None,
                mbp: Optional[float] = None,
-               phase: Optional[str] = None,
-               pack: Optional[dict] = None) -> None:
+               phase: Optional[str] = None) -> None:
         with self._lock:
             if done is not None:
                 self._done = done
@@ -77,26 +99,17 @@ class Heartbeat:
                 self._mbp = mbp
             if phase is not None:
                 self._phase = phase
-            if pack is not None:
-                self._pack = pack
 
     def emit(self, tag: str = "heartbeat") -> None:
         with self._lock:
             done, mbp, phase = self._done, self._mbp, self._phase
-            pack = self._pack
         dt = max(1e-9, time.perf_counter() - self._t0)
-        # real packing occupancy of the consensus pair arenas (round 10):
-        # occupied/total lanes and mean windows per dispatched group —
-        # the replacement for the coarse consensus_vpu_util_est
-        occ = ("-" if not pack or not pack.get("groups") else
-               f"{pack['pack_efficiency']:.2f}eff,"
-               f"{pack['windows_per_group']:.0f}w/g,"
-               f"{pack['groups']}g")
         print(f"[racon_tpu::exec] {tag}: shard {done}/{self.n_shards} "
               f"({phase}) {mbp:.2f} Mbp in {dt:.1f}s "
               f"({mbp / dt:.4f} Mbp/s) "
               f"peak_rss={peak_rss_bytes() >> 20}MB "
-              f"pack[{occ}] "
+              f"pack[{pack_summary_str()}] "
+              f"queue[{queue_summary_str()}] "
               f"retrace[{retrace_summary()}]",
               file=self._stream)
         self._stream.flush()

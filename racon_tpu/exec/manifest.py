@@ -29,6 +29,10 @@ from typing import Optional
 from ..utils.logger import warn
 
 MANIFEST_NAME = "manifest.json"
+# the machine-readable run report written next to the manifest (same
+# durable-write protocol; schema in racon_tpu/obs/report.py) — future
+# service-mode job accounting reads shard rows from here
+REPORT_NAME = "run_report.json"
 VERSION = 1
 
 DONE = "done"

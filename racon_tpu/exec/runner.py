@@ -19,6 +19,7 @@ and ``bench.py``.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import shutil
 import sys
@@ -27,11 +28,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import flags
+from .. import flags, obs
 from ..core.backends import make_aligner, make_consensus
 from ..core.polisher import PolisherType, create_polisher
 from ..io import parsers
-from ..sanitize import PhaseRetraceBudget
+from ..obs import metrics, report as obs_report
 from ..utils.logger import warn
 from . import heartbeat as hb
 from . import manifest as mf
@@ -105,6 +106,7 @@ class ShardRunner:
         self.index: Optional[RunIndex] = None
         self.plan: Optional[ShardPlan] = None
         self.summary: Dict = {}
+        self.report: Dict = {}     # obs run report (also in work_dir)
         self._engines = None       # (aligner, consensus) — reused per shard
         self._cpu_engines = None   # lazy retry pair
 
@@ -139,15 +141,28 @@ class ShardRunner:
         polished FASTA to the binary stream ``out``. Returns the summary
         dict (also kept as :attr:`summary`)."""
         t0 = time.perf_counter()
+        t_start = time.time()
+        # run boundary: drop per-run metrics so a second in-process run
+        # (bench_shards, tests, future service mode) reports its own
+        # pack/queue/retrace numbers, then arm the span timers (ring
+        # buffers stay off unless the CLI requested a trace): every
+        # exec run persists a run report next to the manifest and its
+        # dispatch-vs-fetch split must hold real seconds, not
+        # schema-valid zeros
+        metrics.clear_run()
+        obs.trace.activate()
         _eprint(f"indexing {os.path.basename(self.overlaps)} / "
                 f"{os.path.basename(self.sequences)}")
-        self.index = build_index(self.sequences, self.overlaps,
-                                 self.target_sequences, self.type,
-                                 self.error_threshold)
+        with obs.span("exec.index"):
+            self.index = build_index(self.sequences, self.overlaps,
+                                     self.target_sequences, self.type,
+                                     self.error_threshold)
         base_rss = hb.peak_rss_bytes()
-        self.plan = plan_shards(self.index, self.n_shards,
-                                self.max_ram_bytes, self.max_target_bytes,
-                                base_rss=base_rss)
+        with obs.span("exec.plan"):
+            self.plan = plan_shards(self.index, self.n_shards,
+                                    self.max_ram_bytes,
+                                    self.max_target_bytes,
+                                    base_rss=base_rss)
         os.makedirs(self.work_dir, exist_ok=True)
         # a valid resume manifest ADOPTS the stored plan (a --max-ram
         # plan depends on the planning process's live RSS, so this
@@ -173,15 +188,19 @@ class ShardRunner:
                     beat.update(done=si + 1, mbp=mbp_done, phase="resume")
                     continue
                 beat.update(done=si, phase="polishing")
-                self._run_shard(si, shard, entry, manifest, beat)
+                # per-shard trace track: every shard's spans land on
+                # their own Perfetto row
+                with obs.track(f"shard {si}"), \
+                        obs.span("exec.shard", shard=si):
+                    self._run_shard(si, shard, entry, manifest, beat)
                 if entry["status"] == mf.DONE:
                     mbp_done += shard_mbp
-                beat.update(done=si + 1, mbp=mbp_done,
-                            pack=self._consensus_pack())
+                beat.update(done=si + 1, mbp=mbp_done)
                 beat.emit(f"shard {si} {entry['status']} "
                           f"engine={entry.get('engine', '-')}")
             beat.update(phase="merging")
-            self._merge_parts(manifest, out)
+            with obs.span("exec.merge"):
+                self._merge_parts(manifest, out)
         finally:
             beat.stop()
 
@@ -200,9 +219,20 @@ class ShardRunner:
             "base_rss_bytes": base_rss,
             "budget_bytes": self.plan.budget_bytes,
             "quarantined": [e["id"] for e in quarantined],
-            "consensus_pack": self._consensus_pack() or {},
+            "consensus_pack": metrics.pack_summary(),
             "shards": [dict(e) for e in manifest["shards"]],
         }
+        # machine-readable run report next to the manifest (same durable
+        # write protocol): BENCH entries, the heartbeat and future
+        # service-mode job accounting are all views over this artifact.
+        # An explicit --shard-dir (or a quarantine) keeps it on disk; a
+        # derived work dir takes it down with the rest of a fully
+        # successful run — pass --run-report for a copy that survives.
+        self.report = obs_report.build_report(
+            "exec", started_unix=t_start, wall_s=wall,
+            shards=manifest["shards"])
+        mf.atomic_write(os.path.join(self.work_dir, mf.REPORT_NAME),
+                        json.dumps(self.report, indent=1).encode())
         if not quarantined and not self.keep_work_dir:
             shutil.rmtree(self.work_dir, ignore_errors=True)
         return self.summary
@@ -278,16 +308,6 @@ class ShardRunner:
                                banded=self.banded))
         return self._engines
 
-    def _consensus_pack(self) -> Optional[dict]:
-        """Cumulative pair-arena occupancy of the reused device
-        consensus engine (None for CPU-only runs) — feeds the heartbeat
-        ``pack[...]`` field and the run summary."""
-        if self._engines is not None:
-            pm = getattr(self._engines[1], "pack_metrics", None)
-            if pm is not None:
-                return pm()
-        return None
-
     def _run_shard(self, si: int, shard: List[int], entry: dict,
                    manifest: dict, beat) -> None:
         sleep_s = flags.get_float("RACON_TPU_EXEC_SLEEP_S")
@@ -295,12 +315,13 @@ class ShardRunner:
             time.sleep(sleep_s)  # test hook: widen the kill window
         entry["status"] = mf.RUNNING
         mf.save_manifest(self.work_dir, manifest)
-        # per-shard attribution: the deltas are a process-wide dict, so
+        # per-shard attribution: the retrace gauges are process-wide, so
         # a shard that short-circuits (zero overlaps) must not inherit
         # the previous shard's compile churn as its own telemetry
-        PhaseRetraceBudget.last_deltas.clear()
+        metrics.clear("retrace.")
         t0 = time.perf_counter()
-        paths = self._extract_shard(si, shard)
+        with obs.span("exec.extract", shard=si):
+            paths = self._extract_shard(si, shard)
         extract_s = time.perf_counter() - t0
 
         fault_shard, fault_always = _fault_spec()
@@ -351,7 +372,7 @@ class ShardRunner:
             wall_s=round(time.perf_counter() - t0, 2),
             extract_s=round(extract_s, 2),
             timings=timings,
-            retrace=dict(PhaseRetraceBudget.last_deltas),
+            retrace=metrics.group("retrace."),
             peak_rss_mb=hb.peak_rss_bytes() >> 20)
         if reason is not None:
             entry["reason"] = reason  # first attempt's fault, CPU-retried

@@ -83,6 +83,21 @@ REGISTRY: Dict[str, Flag] = _declare([
     Flag("RACON_TPU_COMPILE_CACHE", "", "path",
          "Persistent XLA compilation cache directory (default "
          "~/.cache/racon_tpu_xla)."),
+    # ------------------------------------------------------- observability
+    Flag("RACON_TPU_TRACE", "", "path",
+         "Write a Chrome trace-event JSON of the run's pipeline spans "
+         "(parse/align/decode/build/consensus/stitch, queue waits, "
+         "per-shard tracks) to this file — load it in Perfetto or "
+         "chrome://tracing; equivalent to the CLI --trace flag."),
+    Flag("RACON_TPU_JAX_PROFILE", "", "path",
+         "Bracket the polish phase in jax.profiler.trace writing to "
+         "this directory, so XLA device activity lines up with the "
+         "host spans (view with TensorBoard / xprof)."),
+    Flag("RACON_TPU_RUN_REPORT", "", "path",
+         "Write the schema-versioned machine-readable run_report.json "
+         "(per-phase wall clock, dispatch-vs-fetch split, pack "
+         "occupancy, retrace and queue-stall metrics, per-shard rows) "
+         "to this file; equivalent to the CLI --run-report flag."),
     # ----------------------------------------------------------- sanitizer
     Flag("RACON_TPU_SANITIZE", "0", "bool",
          "Runtime sanitizer: int32 shadow execution of sampled SWAR "

@@ -1,0 +1,49 @@
+"""racon_tpu.obs — the unified observability subsystem.
+
+Three layers over one registry:
+
+- **spans** (:mod:`.trace`) — ``with obs.span("align.dispatch"): ...``
+  context-manager tracing threaded through the whole pipeline, exported
+  as Chrome trace-event JSON (``--trace FILE`` / ``RACON_TPU_TRACE``,
+  load in Perfetto).  Disabled spans cost one branch; spans never
+  change output bytes.
+- **metrics** (:mod:`.metrics`) — THE process-wide registry of named
+  counters/gauges/timers.  Producers (engines, sanitizer, logger,
+  polisher queue) publish; the heartbeat, ``consensus_stats``, bench
+  and the run report read.
+- **run reports** (:mod:`.report`) — schema-versioned
+  ``run_report.json`` per CLI/exec run (``--run-report FILE`` /
+  ``RACON_TPU_RUN_REPORT``), validated first-party.
+
+``RACON_TPU_JAX_PROFILE=DIR`` additionally brackets the polish phase in
+``jax.profiler.trace`` so XLA device activity lines up with the host
+spans (:func:`jax_profile`).
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+from . import metrics, report, trace
+from .trace import span, track  # noqa: F401  (the public span surface)
+
+
+def begin(trace_path=None, report_path=None) -> None:
+    """Mark a run boundary (per-run metrics reset) and arm span
+    recording: timers whenever either output was requested, ring
+    buffers only when a trace file was."""
+    metrics.clear_run()
+    if trace_path or report_path:
+        trace.activate(tracing=bool(trace_path))
+
+
+def jax_profile():
+    """A context manager bracketing the enclosed phase in
+    ``jax.profiler.trace(RACON_TPU_JAX_PROFILE)`` — a no-op nullcontext
+    when the flag is unset (jax is not even imported then)."""
+    from .. import flags
+    profile_dir = flags.get_str("RACON_TPU_JAX_PROFILE")
+    if not profile_dir:
+        return nullcontext()
+    import jax
+    return jax.profiler.trace(profile_dir)

@@ -1,0 +1,8 @@
+"""``python -m racon_tpu.obs --check FILE`` — run-report validation
+(the CI e2e check drives this)."""
+
+import sys
+
+from .report import _main
+
+sys.exit(_main(sys.argv[1:]))

@@ -1,0 +1,166 @@
+"""The process-wide metrics registry — ONE store for every counter,
+gauge and timer the pipeline publishes.
+
+Before this module, telemetry lived in five ad-hoc surfaces (stage lines
+in ``utils/logger.py``, the exec heartbeat's ``update(...)`` plumbing,
+``PhaseRetraceBudget`` class globals, the per-engine ``stats`` dicts and
+bench.py's hand-rolled JSON).  Those surfaces now all *read* this
+registry; producers publish with :func:`inc` / :func:`set_gauge` /
+:func:`add_time` at the same sites that update their local state.
+
+Three kinds, uniform dotted names (``consensus.groups``,
+``retrace.align``, ``queue.producer_wait_s``):
+
+- **counters** — monotone accumulators (:func:`inc`);
+- **gauges**   — last-written values (:func:`set_gauge`);
+- **timers**   — accumulated seconds (:func:`add_time`; span exits from
+  :mod:`racon_tpu.obs.trace` land here keyed by the span name, which is
+  where the run report's dispatch-vs-fetch split comes from).
+
+The module IS the registry (state in module globals under one lock), so
+``from racon_tpu.obs import metrics; metrics.inc(...)`` works from
+anywhere without wiring an object through the call graph.  Dependency-
+free (no jax, no numpy): importable from ``tests/conftest.py`` and
+``utils/logger.py`` before any backend initializes.  Updates are a dict
+write under a lock — nanoseconds against the chunk/group granularity of
+every publishing site.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Optional, Union
+
+Number = Union[int, float]
+
+_lock = threading.Lock()
+_counters: Dict[str, Number] = {}
+_gauges: Dict[str, Number] = {}
+_timers: Dict[str, float] = {}
+
+
+def inc(name: str, delta: Number = 1) -> None:
+    """Add ``delta`` to counter ``name`` (created at 0)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + delta
+
+
+def set_gauge(name: str, value: Number) -> None:
+    """Set gauge ``name`` to ``value`` (last write wins)."""
+    with _lock:
+        _gauges[name] = value
+
+
+def add_time(name: str, seconds: float) -> None:
+    """Accumulate ``seconds`` onto timer ``name``."""
+    with _lock:
+        _timers[name] = _timers.get(name, 0.0) + seconds
+
+
+def counter(name: str, default: Number = 0) -> Number:
+    with _lock:
+        return _counters.get(name, default)
+
+
+def gauge(name: str, default: Number = 0) -> Number:
+    with _lock:
+        return _gauges.get(name, default)
+
+
+def timer_s(name: str, default: float = 0.0) -> float:
+    with _lock:
+        return _timers.get(name, default)
+
+
+def group(prefix: str) -> Dict[str, Number]:
+    """Every metric under ``prefix`` (all three kinds merged), keyed by
+    the name with the prefix stripped — e.g. ``group("retrace.")`` is
+    the per-phase jit-retrace delta dict the heartbeat and bench print."""
+    out: Dict[str, Number] = {}
+    with _lock:
+        for store in (_counters, _gauges, _timers):
+            for k, v in store.items():
+                if k.startswith(prefix):
+                    out[k[len(prefix):]] = v
+    return out
+
+
+def clear(prefix: Optional[str] = None) -> None:
+    """Drop metrics under ``prefix`` (every metric when None) — the
+    shard runner clears ``retrace.`` between shards so a shard that
+    short-circuits does not inherit the previous shard's churn."""
+    with _lock:
+        for store in (_counters, _gauges, _timers):
+            if prefix is None:
+                store.clear()
+            else:
+                for k in [k for k in store if k.startswith(prefix)]:
+                    del store[k]
+
+
+# every name a run report / runner summary / heartbeat reads describes
+# ONE run; span timers land keyed by the span name, hence the phase
+# prefixes.  "trace." covers the dropped-events gauge of the run's own
+# ring buffers.
+_RUN_PREFIXES = ("align.", "poa.", "consensus.", "queue.", "retrace.",
+                 "retrace_total.", "swallowed.", "trace.", "parse.",
+                 "overlap.", "transmute", "bp.", "build.", "stitch",
+                 "exec.")
+
+
+def clear_run() -> None:
+    """Drop every per-run metric (:data:`_RUN_PREFIXES`) — called at
+    run boundaries (``obs.begin``, ``ShardRunner.run``, bench legs) so
+    back-to-back runs in one process each report their own numbers
+    instead of process-lifetime accumulations."""
+    for prefix in _RUN_PREFIXES:
+        clear(prefix)
+
+
+def snapshot() -> Dict[str, Dict[str, Number]]:
+    """Point-in-time copy of the whole registry (the run report embeds
+    it verbatim)."""
+    with _lock:
+        return {"counters": dict(_counters), "gauges": dict(_gauges),
+                "timers": {k: round(v, 6) for k, v in _timers.items()}}
+
+
+# ------------------------------------------------------------ derived views
+
+def pack_summary() -> Dict[str, Number]:
+    """Pair-arena occupancy derived from the ``consensus.*`` counters
+    the device engine publishes per launch — the registry twin of
+    ``TpuPoaConsensus.pack_metrics()``, cumulative since the last run
+    boundary (:func:`clear_run`)."""
+    with _lock:
+        tot = _counters.get("consensus.lanes_total", 0)
+        occ = _counters.get("consensus.lanes_occupied", 0)
+        grp = _counters.get("consensus.groups", 0)
+        wins = _counters.get("consensus.group_windows", 0)
+    eff = occ / tot if tot else 0.0
+    return {"pack_efficiency": round(eff, 4),
+            "pad_fraction": round(1.0 - eff, 4) if tot else 0.0,
+            "windows_per_group": round(wins / grp, 2) if grp else 0.0,
+            "groups": grp}
+
+
+def queue_summary() -> Dict[str, Number]:
+    """The pipelined ``Polisher.run()`` bounded-queue health metrics:
+    current depth plus accumulated producer/consumer blocking time."""
+    with _lock:
+        depth = _gauges.get("queue.depth", 0)
+        put_s = _timers.get("queue.producer_wait_s", 0.0)
+        get_s = _timers.get("queue.consumer_wait_s", 0.0)
+    return {"depth": depth,
+            "producer_wait_s": round(put_s, 3),
+            "consumer_wait_s": round(get_s, 3),
+            "stall_s": round(put_s + get_s, 3)}
+
+
+def peak_rss_bytes() -> int:
+    """Lifetime peak RSS of this process (ru_maxrss is KiB on Linux,
+    bytes on macOS)."""
+    import resource
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return rss if sys.platform == "darwin" else rss * 1024

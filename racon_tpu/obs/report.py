@@ -1,0 +1,228 @@
+"""Machine-readable run reports: one schema-versioned ``run_report.json``
+per CLI/exec run.
+
+BENCH entries, the exec heartbeat and any future service-mode job
+accounting are all *views* over this artifact: per-phase wall clock,
+the dispatch-vs-fetch split (from the span timers), pair-arena
+occupancy, jit-retrace deltas, bounded-queue stall time, the swallowed-
+fault suppression counts, peak RSS, and (for exec runs) one row per
+shard.  Everything is pulled from the single metrics registry
+(:mod:`racon_tpu.obs.metrics`) at build time — no producer plumbs its
+own dict here.
+
+The schema is first-party and versioned (:data:`SCHEMA_VERSION`):
+:func:`validate_report` returns a list of human-readable violations
+(empty = valid) and is wired into CI's e2e check and
+``python -m racon_tpu.obs.report --check FILE``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from . import metrics
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+# top-level schema: key -> (accepted types, required)
+_TOP = {
+    "schema_version": (int, True),
+    "kind": (str, True),                # "cli" | "exec"
+    "argv": (list, False),
+    "started_unix": (_NUM, True),
+    "wall_s": (_NUM, True),
+    "phases": (dict, True),             # phase -> seconds
+    "dispatch_fetch": (dict, True),     # split -> seconds
+    "pack": (dict, True),               # occupancy summary
+    "retrace": (dict, True),            # phase -> jit-compile delta
+    "queue": (dict, True),              # bounded-queue health
+    "swallowed": (dict, True),          # fault key -> occurrence count
+    "peak_rss_bytes": (int, True),
+    "metrics": (dict, True),            # full registry snapshot
+    "shards": (list, False),            # exec runs: one row per shard
+}
+
+_QUEUE_KEYS = ("depth", "producer_wait_s", "consumer_wait_s", "stall_s")
+_PACK_KEYS = ("pack_efficiency", "pad_fraction", "windows_per_group",
+              "groups")
+
+# per-shard row schema: key -> (accepted types, required)
+_SHARD_ROW = {
+    "id": (int, True),
+    "status": (str, True),
+    "engine": (str, False),
+    "mbp": (_NUM, False),
+    "wall_s": (_NUM, False),
+    "extract_s": (_NUM, False),
+    "timings": (dict, False),
+    "retrace": (dict, False),
+    "peak_rss_mb": (int, False),
+    "reason": (str, False),
+}
+
+
+def build_report(kind: str, *, argv: Optional[list] = None,
+                 started_unix: float = 0.0, wall_s: float = 0.0,
+                 phases: Optional[Dict[str, float]] = None,
+                 shards: Optional[List[dict]] = None) -> dict:
+    """Assemble a report from the metrics registry plus the caller's
+    phase timings (``Polisher.timings``) and, for exec runs, the
+    manifest's shard entries (:func:`shard_row` extracts the row)."""
+    rep = {
+        "schema_version": SCHEMA_VERSION,
+        "kind": kind,
+        "argv": [str(a) for a in (argv or [])],
+        "started_unix": round(float(started_unix), 3),
+        "wall_s": round(float(wall_s), 3),
+        "phases": {str(k): round(float(v), 6)
+                   for k, v in (phases or {}).items()},
+        "dispatch_fetch": {
+            "align_dispatch_s": round(metrics.timer_s("align.dispatch"), 3),
+            "align_fetch_s": round(metrics.timer_s("align.fetch"), 3),
+            "consensus_pack_s": round(metrics.timer_s("poa.pack"), 3),
+            "consensus_dispatch_s": round(
+                metrics.timer_s("poa.dispatch"), 3),
+            "consensus_fetch_s": round(metrics.timer_s("poa.fetch"), 3),
+        },
+        "pack": metrics.pack_summary(),
+        # process-lifetime totals (the "retrace." gauges hold only the
+        # most recent per-phase delta and the exec runner clears them
+        # between shards for per-shard attribution; the "_total"
+        # counters accumulate across the whole run — identical for
+        # single-polisher cli runs)
+        "retrace": (metrics.group("retrace_total.")
+                    or metrics.group("retrace.")),
+        "queue": metrics.queue_summary(),
+        "swallowed": {k: int(v)
+                      for k, v in metrics.group("swallowed.").items()},
+        "peak_rss_bytes": metrics.peak_rss_bytes(),
+        "metrics": metrics.snapshot(),
+    }
+    if shards is not None:
+        rep["shards"] = [shard_row(e) for e in shards]
+    return rep
+
+
+def shard_row(entry: dict) -> dict:
+    """One report row from a manifest shard entry (schema-checked keys
+    only — manifest internals like part paths stay out of the report)."""
+    row = {"id": int(entry["id"]), "status": str(entry["status"])}
+    for key in ("engine", "mbp", "wall_s", "extract_s", "timings",
+                "retrace", "peak_rss_mb", "reason"):
+        if entry.get(key) is not None:
+            row[key] = entry[key]
+    return row
+
+
+# ------------------------------------------------------------- validation
+
+def _check_numeric_dict(errors: List[str], d: dict, where: str) -> None:
+    for k, v in d.items():
+        if not isinstance(k, str) or not isinstance(v, _NUM) \
+                or isinstance(v, bool):
+            errors.append(f"{where}[{k!r}] is not a numeric value: {v!r}")
+
+
+def validate_report(rep) -> List[str]:
+    """Schema-check a (parsed) report; returns violations, [] = valid."""
+    errors: List[str] = []
+    if not isinstance(rep, dict):
+        return [f"report is not an object: {type(rep).__name__}"]
+    if rep.get("schema_version") != SCHEMA_VERSION:
+        errors.append(f"schema_version {rep.get('schema_version')!r} "
+                      f"!= {SCHEMA_VERSION}")
+    for key, (types, required) in _TOP.items():
+        if key not in rep:
+            if required:
+                errors.append(f"missing required key {key!r}")
+            continue
+        if not isinstance(rep[key], types) or isinstance(rep[key], bool):
+            errors.append(f"{key!r} has type {type(rep[key]).__name__}")
+    for key in set(rep) - set(_TOP):
+        errors.append(f"unknown key {key!r}")
+    if errors:
+        return errors
+    if rep["kind"] not in ("cli", "exec"):
+        errors.append(f"kind {rep['kind']!r} not in ('cli', 'exec')")
+    for key in ("phases", "dispatch_fetch", "retrace", "swallowed"):
+        _check_numeric_dict(errors, rep[key], key)
+    for key in _QUEUE_KEYS:
+        if not isinstance(rep["queue"].get(key), _NUM):
+            errors.append(f"queue[{key!r}] missing or non-numeric")
+    for key in _PACK_KEYS:
+        if not isinstance(rep["pack"].get(key), _NUM):
+            errors.append(f"pack[{key!r}] missing or non-numeric")
+    for kind in ("counters", "gauges", "timers"):
+        store = rep["metrics"].get(kind)
+        if not isinstance(store, dict):
+            errors.append(f"metrics[{kind!r}] missing or not an object")
+        else:
+            _check_numeric_dict(errors, store, f"metrics.{kind}")
+    for i, row in enumerate(rep.get("shards", [])):
+        if not isinstance(row, dict):
+            errors.append(f"shards[{i}] is not an object")
+            continue
+        for key, (types, required) in _SHARD_ROW.items():
+            if key not in row:
+                if required:
+                    errors.append(f"shards[{i}] missing {key!r}")
+                continue
+            if not isinstance(row[key], types) \
+                    or isinstance(row[key], bool):
+                errors.append(
+                    f"shards[{i}][{key!r}] has type "
+                    f"{type(row[key]).__name__}")
+        for key in set(row) - set(_SHARD_ROW):
+            errors.append(f"shards[{i}] unknown key {key!r}")
+    return errors
+
+
+def atomic_write_bytes(path: str, blob: bytes) -> None:
+    """tmp + fsync + atomic replace — the manifest's durable-write
+    protocol (``exec.manifest.atomic_write``) re-stated here because
+    obs must stay import-light (no exec package pull-in). Shared by
+    :func:`write_report` and the trace exporter: a crash mid-write
+    leaves the previous artifact, never a truncated one."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_report(path: str, rep: dict) -> None:
+    """Serialize + durably replace ``path`` (a half-written report is
+    worse than none)."""
+    atomic_write_bytes(path, json.dumps(rep, indent=1).encode())
+
+
+def _main(argv) -> int:
+    if len(argv) == 2 and argv[0] == "--check":
+        try:
+            with open(argv[1], "rb") as f:
+                rep = json.loads(f.read())
+        except (OSError, ValueError) as e:
+            print(f"run report {argv[1]}: unreadable ({e})",
+                  file=sys.stderr)
+            return 2
+        errors = validate_report(rep)
+        for err in errors:
+            print(f"run report {argv[1]}: {err}", file=sys.stderr)
+        if not errors:
+            print(f"run report {argv[1]}: valid "
+                  f"(schema v{SCHEMA_VERSION}, kind={rep['kind']}, "
+                  f"{len(rep.get('shards', []))} shard rows)")
+        return 1 if errors else 0
+    print("usage: python -m racon_tpu.obs --check FILE",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv[1:]))

@@ -1,0 +1,245 @@
+"""Span tracing: low-overhead pipeline spans exported as Chrome
+trace-event JSON (loadable in Perfetto / chrome://tracing).
+
+The one public span surface is the context manager::
+
+    with obs.span("align.dispatch", pairs=len(chunk)):
+        ...
+
+(the graftlint rule ``span-discipline`` enforces the ``with`` form —
+manual begin/end pairs leak open spans when an exception unwinds).
+
+Two independent switches:
+
+- **active** (:func:`activate`) — span exits accumulate their duration
+  into the metrics registry's timers keyed by the span name (the run
+  report's dispatch-vs-fetch split reads them).  On by itself when only
+  a run report was requested.
+- **tracing** (``activate(tracing=True)``) — span events additionally
+  land in per-thread ring buffers (bounded: the oldest events of a
+  thread drop first, counted in ``trace.dropped_events``) for
+  :func:`export`.
+
+When neither is on — the default — ``span()`` returns one shared no-op
+singleton: the cost is a module-global load, a branch and a constant
+return, which is what keeps always-compiled-in spans out of the hot
+loops' profile (guarded by ``tests/test_obs.py``).  Output bytes are
+identical either way: spans observe, they never steer.
+
+Threads get their own buffer (and their own Perfetto track) the first
+time they record a span; :func:`track` pushes a named sub-track for the
+current thread (the shard runner wraps each shard in one, so a run's
+shards land on separate rows of the trace viewer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from . import metrics
+
+# events kept per (thread, ring): ~64 bytes/event -> a few MB per thread
+RING_CAP = 1 << 18
+
+_lock = threading.Lock()
+_active = False
+_tracing = False
+_origin = 0.0          # perf_counter at tracing start (trace time zero)
+_threads: List["_ThreadBuf"] = []
+_epoch = 0             # bumped by deactivate(): stale thread-local
+                       # buffers re-register instead of recording into
+                       # orphaned (never-exported) rings
+_tls = threading.local()
+
+
+class _ThreadBuf:
+    """Per-thread ring buffer of finished span events plus the thread's
+    current :func:`track` stack."""
+
+    __slots__ = ("name", "events", "pos", "dropped", "tracks", "epoch")
+
+    def __init__(self, name: str, epoch: int):
+        self.name = name
+        self.events: list = []     # (track, name, t0, t1, args)
+        self.pos = 0
+        self.dropped = 0
+        self.tracks: List[str] = []
+        self.epoch = epoch
+
+    def append(self, ev) -> None:
+        if len(self.events) < RING_CAP:
+            self.events.append(ev)
+        else:
+            self.events[self.pos] = ev
+            self.pos = (self.pos + 1) % RING_CAP
+            self.dropped += 1
+
+
+def _buf() -> _ThreadBuf:
+    b = getattr(_tls, "buf", None)
+    if b is None or b.epoch != _epoch:
+        b = _ThreadBuf(threading.current_thread().name, _epoch)
+        _tls.buf = b
+        with _lock:
+            _threads.append(b)
+    return b
+
+
+class _NullSpan:
+    """Shared no-op span/track returned whenever recording is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_t0")
+
+    def __init__(self, name: str, args: dict):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        metrics.add_time(self.name, t1 - self._t0)
+        if _tracing:
+            b = _buf()
+            b.append((b.tracks[-1] if b.tracks else None,
+                      self.name, self._t0, t1, self.args or None))
+        return False
+
+
+def span(name: str, **args):
+    """A context manager timing the enclosed block as span ``name``
+    (optional ``args`` become the event's Perfetto args). Use ONLY as
+    ``with obs.span(...):`` — the span-discipline lint enforces it."""
+    if not _active:
+        return NULL_SPAN
+    return _Span(name, args)
+
+
+class _Track:
+    __slots__ = ("name", "_b")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._b = _buf()
+        self._b.tracks.append(self.name)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # pop from the buffer we pushed onto — if deactivate() bumped
+        # the epoch mid-track, the thread-local buffer was replaced and
+        # our push lives only on the orphaned one (popping a fresh
+        # buffer's empty list would raise)
+        b = getattr(_tls, "buf", None)
+        if b is self._b and b.tracks:
+            b.tracks.pop()
+        return False
+
+
+def track(name: str):
+    """Route the current thread's spans onto a named sub-track until
+    exit (e.g. one track per shard in the trace viewer)."""
+    if not _tracing:
+        return NULL_SPAN
+    return _Track(name)
+
+
+# ------------------------------------------------------------- lifecycle
+
+def activate(tracing: bool = False) -> None:
+    """Turn span recording on: timers always, ring buffers when
+    ``tracing``. Idempotent; tracing time zero is set at the first
+    tracing activation."""
+    global _active, _tracing, _origin
+    _active = True
+    if tracing and not _tracing:
+        _origin = time.perf_counter()
+        _tracing = True
+
+
+def deactivate() -> None:
+    """Full reset (tests): recording off, every thread buffer dropped.
+    Live threads' stale thread-local buffers re-register on their next
+    span (the epoch bump makes ``_buf`` replace them), so no thread
+    keeps recording into an orphaned, never-exported ring."""
+    global _active, _tracing, _threads, _epoch
+    with _lock:
+        _active = False
+        _tracing = False
+        _threads = []
+        _epoch += 1
+
+
+def is_active() -> bool:
+    return _active
+
+
+def is_tracing() -> bool:
+    return _tracing
+
+
+# ---------------------------------------------------------------- export
+
+def export(path: str) -> dict:
+    """Write every recorded span as Chrome trace-event JSON to ``path``
+    and return ``{"events": n, "dropped": n}``.
+
+    Format: ``{"traceEvents": [...]}`` with complete ("X") events in
+    microseconds relative to tracing start, one tid per (thread, track)
+    pair, and ``thread_name`` metadata rows — exactly what Perfetto and
+    chrome://tracing load directly."""
+    pid = os.getpid()
+    with _lock:
+        bufs = list(_threads)
+    events: list = []
+    dropped = 0
+    tids: dict = {}
+    for b in bufs:
+        dropped += b.dropped
+        # ring order does not matter: the viewer sorts by ts
+        for track_name, name, t0, t1, args in b.events:
+            key = (b.name, track_name)
+            tid = tids.get(key)
+            if tid is None:
+                tid = len(tids) + 1
+                tids[key] = tid
+                label = (b.name if track_name is None
+                         else f"{b.name}/{track_name}")
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": label}})
+            ev = {"name": name, "cat": name.split(".", 1)[0], "ph": "X",
+                  "pid": pid, "tid": tid,
+                  "ts": round((t0 - _origin) * 1e6, 3),
+                  "dur": round((t1 - t0) * 1e6, 3)}
+            if args:
+                ev["args"] = args
+            events.append(ev)
+    if dropped:
+        metrics.set_gauge("trace.dropped_events", dropped)
+    events.insert(0, {"name": "process_name", "ph": "M", "pid": pid,
+                      "args": {"name": "racon_tpu"}})
+    from .report import atomic_write_bytes
+    atomic_write_bytes(path, json.dumps(
+        {"traceEvents": events, "displayTimeUnit": "ms"}).encode())
+    return {"events": len(events), "dropped": dropped}

@@ -446,6 +446,8 @@ def _ops_to_cigar(path: np.ndarray) -> str:
 
 
 from .pallas_nw import PallasDispatchMixin
+from .. import obs
+from ..obs import metrics
 
 
 class TpuAligner(PallasDispatchMixin):
@@ -493,6 +495,7 @@ class TpuAligner(PallasDispatchMixin):
             return False
         if not swar_fits(max_len):
             self.stats["swar_guard_int32"] += 1
+            metrics.inc("aligner.swar_guard_int32")
             return False
         return swar_ok()
 
@@ -651,9 +654,11 @@ class TpuAligner(PallasDispatchMixin):
                     nbi = self._bucket_index(len(q), len(t), bi + 1)
                     if nbi is None:
                         self.stats["fallback_band"] += 1
+                        metrics.inc("aligner.fallback_band")
                         reject.append(idx)
                     else:
                         self.stats["band_escalated"] += 1
+                        metrics.inc("aligner.band_escalated")
                         by_bucket.setdefault(nbi, []).append(idx)
 
         if reject:
@@ -678,6 +683,16 @@ class TpuAligner(PallasDispatchMixin):
         return cigars
 
     def _launch_chunk(self, pairs, chunk, max_len, band, bp_meta=None):
+        """Span-wrapped :meth:`_launch_chunk_impl` — the dispatch half
+        of the aligner's dispatch-vs-fetch split (host pack + async
+        kernel dispatch; the device computes after this returns)."""
+        with obs.span("align.dispatch", pairs=len(chunk),
+                      max_len=max_len, band=band):
+            return self._launch_chunk_impl(pairs, chunk, max_len, band,
+                                           bp_meta)
+
+    def _launch_chunk_impl(self, pairs, chunk, max_len, band,
+                           bp_meta=None):
         """Pack a chunk and dispatch its kernels; returns the in-flight
         handle consumed by ``_finish_chunk``. Device work proceeds
         asynchronously after dispatch.
@@ -763,6 +778,7 @@ class TpuAligner(PallasDispatchMixin):
                 # counted on the path actually taken: the Pallas-level
                 # decision can differ from the XLA-level one
                 self.stats["swar_chunks"] += int(sw_p)
+                metrics.inc("aligner.swar_chunks", int(sw_p))
                 return chunk, pairs, n, m, out, (max_len, key)
             except Exception as e:
                 from .. import sanitize
@@ -787,6 +803,7 @@ class TpuAligner(PallasDispatchMixin):
         out = self._attach_bp(out, chunk, pairs, n, m, max_len, bp_meta,
                               put)
         self.stats["swar_chunks"] += int(sw)
+        metrics.inc("aligner.swar_chunks", int(sw))
         return chunk, pairs, n, m, out, (max_len, None)
 
     def _attach_bp(self, out, chunk, pairs, n, m, max_len, bp_meta, put):
@@ -844,6 +861,14 @@ class TpuAligner(PallasDispatchMixin):
         return out
 
     def _finish_chunk(self, launched, band, cigars, reject, bp_meta=None):
+        """Span-wrapped :meth:`_finish_chunk_impl` — the fetch half of
+        the dispatch-vs-fetch split (blocks on the device result)."""
+        with obs.span("align.fetch", pairs=len(launched[0]), band=band):
+            self._finish_chunk_impl(launched, band, cigars, reject,
+                                    bp_meta)
+
+    def _finish_chunk_impl(self, launched, band, cigars, reject,
+                           bp_meta=None):
         chunk, pairs, n, m, out, (max_len, shape_key) = launched
         from ..parallel import fetch_global
         if bp_meta is not None:

@@ -83,8 +83,9 @@ from jax import lax
 
 from .nw import _nw_wavefront_kernel, _walk_ops_kernel
 from .pallas_nw import PallasDispatchMixin
-from .. import flags, sanitize
+from .. import flags, obs, sanitize
 from ..core.window import WindowType
+from ..obs import metrics
 
 # Alignment band for layer-vs-backbone-span alignment (layers are ~window
 # sized; c=256 covers ~50% divergence at 500 bp).
@@ -894,7 +895,10 @@ class _Work:
         self.backbone = win.backbone
         self.bqual = win.backbone_quality
         total = win.layer_count
-        stats["dropped_layers"] += max(0, total - max_depth)
+        over = total - max_depth
+        if over > 0:
+            stats["dropped_layers"] += over
+            metrics.inc("consensus.dropped_layers", over)
         depth = min(total, max_depth)
         self.n_seqs = total + 1
         self.n_layers = depth
@@ -1166,6 +1170,7 @@ class _ConsensusStream:
         cpu_idx = [i for i, r in enumerate(self.results) if r is None]
         if cpu_idx:
             eng.stats["fallback_windows"] += len(cpu_idx)
+            metrics.inc("consensus.fallback_windows", len(cpu_idx))
             if eng.fallback is None:
                 raise RuntimeError(
                     f"{len(cpu_idx)} windows rejected, no CPU fallback")
@@ -1469,6 +1474,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         cpu_idx = [i for i, r in enumerate(results) if r is None]
         if cpu_idx:
             self.stats["fallback_windows"] += len(cpu_idx)
+            metrics.inc("consensus.fallback_windows", len(cpu_idx))
             if self.fallback is None:
                 raise RuntimeError(
                     f"{len(cpu_idx)} windows rejected, no CPU fallback")
@@ -1612,6 +1618,33 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
     # -------------------------------------------------------------- device
 
+    def _launch_group(self, live, Lq, Lb, overrides=None):
+        """Span-wrapped :meth:`_launch_group_impl` — the host-pack half
+        of the consensus dispatch pipeline."""
+        with obs.span("poa.pack", windows=len(live)):
+            return self._launch_group_impl(live, Lq, Lb, overrides)
+
+    def _rounds(self, launch, Lq, Lb, steps, Lq2=0) -> None:
+        """Span-wrapped :meth:`_rounds_impl` — the async kernel dispatch
+        of a group's whole refinement loop."""
+        with obs.span("poa.dispatch", pairs=launch["B"]):
+            self._rounds_impl(launch, Lq, Lb, steps, Lq2)
+
+    def _finish_group(self, launch, trim: bool, results,
+                      retried: bool = False, collect=None) -> None:
+        """Span-wrapped :meth:`_finish_group_impl` — the blocking fetch
+        + decode half (a retry re-dispatch nests under this span)."""
+        with obs.span("poa.fetch", windows=launch["nWp"]):
+            self._finish_group_impl(launch, trim, results,
+                                    retried=retried, collect=collect)
+
+    def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
+                     Lq2, band) -> None:
+        """Span-wrapped :meth:`_run_stage_b_impl`."""
+        with obs.span("poa.stage_b", windows=len(survivors)):
+            self._run_stage_b_impl(survivors, trim, results, Lq, Lb,
+                                   steps, Lq2, band)
+
     def _pack_shard(self, items, Lq, B, nWp, Lb, overrides=None):
         """Pack one shard's windows into fixed-shape pair/window arrays.
 
@@ -1733,7 +1766,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         return (n, qpw, win_of, real, bg, ed), \
                (bcodes, bweights, blen, covs, ever)
 
-    def _launch_group(self, live, Lq, Lb, overrides=None):
+    def _launch_group_impl(self, live, Lq, Lb, overrides=None):
         """Pack one window group (per-mesh-shard when a mesh is set — pairs
         of a window never cross shards, so votes stay shard-local) into the
         device-resident refinement state. ``overrides`` carries fetched
@@ -1762,10 +1795,18 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # occupancy telemetry (round 10): real lane occupancy of this
         # launch's pair arena — occupied = sum of real layer lengths,
         # total = padded rows x the bucket's lane width
-        self.stats["lanes_occupied"] += int(pair_np[0][pair_np[3]].sum())
-        self.stats["lanes_total"] += int(pair_np[0].shape[0]) * Lq
+        occupied = int(pair_np[0][pair_np[3]].sum())
+        lanes = int(pair_np[0].shape[0]) * Lq
+        self.stats["lanes_occupied"] += occupied
+        self.stats["lanes_total"] += lanes
         self.stats["groups"] += 1
         self.stats["group_windows"] += len(live)
+        # registry mirror: the heartbeat / run report read occupancy
+        # from the one process-wide registry, not this engine's dict
+        metrics.inc("consensus.lanes_occupied", occupied)
+        metrics.inc("consensus.lanes_total", lanes)
+        metrics.inc("consensus.groups")
+        metrics.inc("consensus.group_windows", len(live))
         win_np = [np.concatenate([p[1][a] for p in packs])
                   for a in range(5)]
         # single-host: plain device puts; multi-host: every process packs
@@ -1788,7 +1829,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         return {"shards": shards, "static": static, "state": state,
                 "nWp": nWp, "nd": nd, "B": B, "overrides": overrides}
 
-    def _rounds(self, launch, Lq, Lb, steps, Lq2=0) -> None:
+    def _rounds_impl(self, launch, Lq, Lb, steps, Lq2=0) -> None:
         """Dispatch a group's full refinement loop (no host sync).
 
         The Pallas availability probe runs at one small shape, so a Mosaic
@@ -1800,6 +1841,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         the probe's bit-exact comparison)."""
         from .swar import swar_fits, swar_ok
         sw = self.use_swar and swar_fits(Lq) and swar_ok()
+        if self.use_swar and not swar_fits(Lq):
+            # SWAR -> int32 re-dispatch (geometry outgrew the packed
+            # lanes' overflow headroom) — counted like the aligner's
+            metrics.inc("consensus.swar_guard_int32")
         base_key = (Lq, launch.get("band", self.band), steps, Lb, Lq2)
         swar_key = base_key + ("swar",)
         if self._use_pallas(base_key):
@@ -1885,8 +1930,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
             use_swar=use_swar, Lq2=Lq2, scores=self.scores,
             matmul_votes=self.use_matmul_votes)
 
-    def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
-                     Lq2, band) -> None:
+    def _run_stage_b_impl(self, survivors, trim, results, Lq, Lb, steps,
+                          Lq2, band) -> None:
         """Remaining rounds for the stage-A stragglers, re-packed small.
 
         ``survivors`` is ``[(result_index, work, fetched_state), ...]``
@@ -1922,8 +1967,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
         for la in inflight:
             self._finish_group(la, trim, results)
 
-    def _finish_group(self, launch, trim: bool, results,
-                      retried: bool = False, collect=None) -> None:
+    def _finish_group_impl(self, launch, trim: bool, results,
+                           retried: bool = False, collect=None) -> None:
         """One host fetch per group; decode consensus bytes + trim.
 
         With ``collect`` (a list — stage A of a two-stage run), windows
@@ -2023,6 +2068,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
         self.stats["sweep_truncated"] += int(dropped[:, 1].sum())
         self.stats["ins_overflow"] += int(dropped[:, 2].sum())
         self.stats["wavefront_steps"] += int(dropped[:, 3].sum())
+        metrics.inc("consensus.dropped_layers", int(dropped[:, 0].sum()))
+        metrics.inc("consensus.sweep_truncated", int(dropped[:, 1].sum()))
+        metrics.inc("consensus.ins_overflow", int(dropped[:, 2].sum()))
+        metrics.inc("consensus.wavefront_steps", int(dropped[:, 3].sum()))
         B = launch["B"]
         for s, sh in enumerate(shards):
             off = 0  # pair-row offset within this shard's pack

@@ -42,6 +42,7 @@ import traceback
 from typing import Optional, Sequence
 
 from . import flags
+from .obs import metrics
 from .utils.logger import warn
 
 
@@ -195,10 +196,11 @@ class PhaseRetraceBudget:
     """Context manager asserting a pipeline phase compiles at most
     ``budget`` new jit entries (default from
     ``RACON_TPU_SANITIZE_RETRACE_BUDGET``). The delta is **always**
-    measured and recorded in :attr:`last_deltas` on a clean exit (the
-    scan walks already-imported modules — microseconds per phase — so
-    bench.py reports and the shard runner's heartbeat line get compile
-    churn without paying for shadow execution); the budget itself is
+    measured and published to the metrics registry as the gauge
+    ``retrace.<phase>`` on a clean exit (the scan walks already-imported
+    modules — microseconds per phase — so bench.py reports and the
+    shard runner's heartbeat line read compile churn from the one
+    registry without paying for shadow execution); the budget itself is
     only *enforced* when the sanitizer is armed.
 
     ``prefixes`` scopes the counted modules: the polisher's align phase
@@ -208,8 +210,6 @@ class PhaseRetraceBudget:
     may still add a few shared-module entries — the default budget has
     ample headroom for those; what the budget hunts is per-chunk
     recompile *growth*.)"""
-
-    last_deltas: dict = {}
 
     def __init__(self, phase: str, budget: Optional[int] = None,
                  prefixes: Sequence[str] = ("racon_tpu",)):
@@ -228,7 +228,12 @@ class PhaseRetraceBudget:
         if exc_type is not None:
             return False
         delta = retrace_count(self.prefixes) - self._start
-        PhaseRetraceBudget.last_deltas[self.phase] = delta
+        # gauge: the MOST RECENT delta per phase (heartbeat/per-shard
+        # attribution; the exec runner clears the prefix between
+        # shards); counter: the run-lifetime total (run reports — it
+        # survives the per-shard clear)
+        metrics.set_gauge(f"retrace.{self.phase}", delta)
+        metrics.inc(f"retrace_total.{self.phase}", delta)
         if not self._armed:
             return False
         budget = (self.budget if self.budget is not None

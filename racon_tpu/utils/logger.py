@@ -11,6 +11,8 @@ from __future__ import annotations
 import sys
 import time
 
+from ..obs import metrics
+
 _seen_swallowed: set = set()
 
 
@@ -27,8 +29,12 @@ def log_swallowed(context: str, exc: BaseException) -> None:
     deliberately continues (fallback paths, optimization failures) calls
     this so no fault disappears silently. De-duplicated per (context,
     exception type): fallback paths can swallow the same fault once per
-    chunk, and one line per cause is signal while thousands are noise."""
+    chunk, and one line per cause is signal while thousands are noise.
+    EVERY occurrence still counts into the metrics registry
+    (``swallowed.<context>|<type>``), so the run report shows how many
+    faults each once-per-cause line actually hid."""
     key = (context, type(exc).__name__)
+    metrics.inc(f"swallowed.{context}|{type(exc).__name__}")
     if key in _seen_swallowed:
         return
     _seen_swallowed.add(key)

@@ -1,0 +1,23 @@
+"""Seeded span-discipline violations (selftest expects 3)."""
+
+from racon_tpu import obs
+
+
+def work():
+    pass
+
+
+def leak_via_assignment():
+    s = obs.span("align.dispatch")  # finding: held by hand
+    s.__enter__()
+    work()
+    s.__exit__(None, None, None)
+
+
+def leak_via_manual_enter():
+    obs.span("poa.fetch").__enter__()  # finding: manual begin, no end
+    work()
+
+
+def leak_via_helper(run_under):
+    run_under(obs.span("exec.shard"))  # finding: span escapes the frame

@@ -1,0 +1,29 @@
+"""Clean span usage (selftest expects 0 reported, 1 pragma-suppressed)."""
+
+from racon_tpu import obs
+
+
+def work(arg=None):
+    pass
+
+
+def good_plain():
+    with obs.span("align.dispatch"):
+        work()
+
+
+def good_as_and_args():
+    with obs.span("poa.pack", windows=3) as sp:
+        work(sp)
+
+
+def good_multi_item():
+    with obs.span("consensus"), obs.span("queue.get"):
+        work()
+
+
+def deliberate_identity_probe():
+    # the disabled-span fast path returns one shared singleton; probing
+    # it is the one sanctioned non-with use
+    probe = obs.span("x")  # graftlint: disable=span-discipline (identity probe of the disabled-path singleton, never entered)
+    work(probe)

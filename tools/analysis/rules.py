@@ -18,6 +18,8 @@ closure built in :mod:`tools.analysis.astutil`.
 |                       | and reads of undeclared flag names             |
 | host-sync-in-hot-loop | device->host pulls / block_until_ready inside  |
 |                       | the per-chunk loops of the engines             |
+| span-discipline       | obs.span(...) used any way other than directly |
+|                       | as a `with` item (manual spans leak open)      |
 """
 
 from __future__ import annotations
@@ -439,6 +441,50 @@ class HostSyncRule(Rule):
         return None
 
 
+# -------------------------------------------------------- span-discipline
+
+class SpanDisciplineRule(Rule):
+    """Observability spans only via ``with obs.span(...):`` — every
+    ``obs.span(...)`` call must appear *directly* as a ``with`` item
+    (``with obs.span(...):`` / ``with obs.span(...) as s:``, including
+    multi-item withs).  Assigning a span to a name, calling
+    ``__enter__``/``__exit__`` by hand, or passing a fresh span into a
+    helper builds a manual begin/end pair that leaks the span open when
+    an exception unwinds between the calls — the exact failure mode the
+    context-manager protocol exists to close.  The tracer internals
+    (``racon_tpu/obs/``) are exempt; a deliberate exception (e.g. an
+    identity probe in a test) takes a reasoned pragma."""
+
+    name = "span-discipline"
+    # dotted call names that create a span (obs.span is the repo idiom;
+    # the bare name covers `from racon_tpu.obs import span`)
+    SPAN_CALLS = {"obs.span", "span", "trace.span", "obs.trace.span"}
+
+    def applies(self, rel: str) -> bool:
+        return (rel.startswith("racon_tpu/") and rel.endswith(".py")
+                and not rel.startswith("racon_tpu/obs/"))
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        with_items: Set[int] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_items.add(id(item.context_expr))
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted(node.func)
+            if fn not in self.SPAN_CALLS or id(node) in with_items:
+                continue
+            out.append(self.finding(
+                module, node,
+                f"`{fn}(...)` used outside a `with` item — a span held "
+                f"by hand leaks open when an exception unwinds; write "
+                f"`with {fn}(...):` (or pragma with the reason)"))
+        return out
+
+
 ALL_RULES = [TracerLeakRule(), SwarGuardRule(), SwallowedExceptionRule(),
-             EnvFlagRegistryRule(), HostSyncRule()]
+             EnvFlagRegistryRule(), HostSyncRule(), SpanDisciplineRule()]
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

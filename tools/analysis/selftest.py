@@ -26,6 +26,8 @@ EXPECT = {
     "env_flag_ok.py": ("env-flag-registry", 0, 0),
     "host_sync_bad.py": ("host-sync-in-hot-loop", 4, 0),
     "host_sync_ok.py": ("host-sync-in-hot-loop", 0, 0),
+    "span_discipline_bad.py": ("span-discipline", 3, 0),
+    "span_discipline_ok.py": ("span-discipline", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }
