@@ -46,6 +46,16 @@ RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
 # round trip — before anything slow runs
 python -m tools.analysis --quiet racon_tpu/exec
 python -m pytest tests/test_exec.py -q
+# fault-tolerance shard (fail-fast, round 12): graftlint gate over the
+# fault registry + lease protocol + ladder runner, then the suite —
+# lease claim/expiry/reclaim races, per-class ladder transitions
+# (backoff / OOM-backpressure re-dispatch parity / stall escalation /
+# quarantine), part CRC verification + re-queue, run-report faults
+# schema, and the 2-worker chaos soak (seeded SIGKILL + injected
+# faults, byte-identical merge)
+python -m tools.analysis --quiet racon_tpu/faults.py racon_tpu/exec \
+  racon_tpu/sanitize.py racon_tpu/io/parsers.py tests/test_faults.py
+python -m pytest tests/test_faults.py -q
 # observability shard (fail-fast, round 11): graftlint gate over the
 # obs package and every span-instrumented producer (span-discipline +
 # the 5 older rules), then the tracer/registry/report suite — trace
@@ -57,7 +67,7 @@ python -m pytest tests/test_obs.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
   --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
-  --ignore=tests/test_obs.py
+  --ignore=tests/test_obs.py --ignore=tests/test_faults.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

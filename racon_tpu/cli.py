@@ -103,6 +103,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "derived from the input paths and parameters, "
                         "removed after a fully successful run; an "
                         "explicit DIR is kept)")
+    p.add_argument("--workers", type=int, default=1, metavar="N",
+                   help="drain the shard manifest with N cooperating "
+                        "worker processes (this one plus N-1 spawned "
+                        "secondaries): workers claim shards via O_EXCL "
+                        "lease files with heartbeats, a dead worker's "
+                        "lease expires and its shard is reclaimed, and "
+                        "output stays byte-identical to a single-shot "
+                        "run; independently launched racon processes "
+                        "sharing one --shard-dir cooperate the same "
+                        "way (implies the streaming shard runner)")
+    # internal: a spawned cooperating worker — adopts the primary's
+    # manifest, claims/polishes shards, emits no merged FASTA
+    p.add_argument("--exec-secondary", action="store_true",
+                   help=argparse.SUPPRESS)
     return p
 
 
@@ -160,10 +174,35 @@ def _finish_obs(trace_path, report_path, kind, argv, t_start, t0,
         obs_report.write_report(report_path, rep)
 
 
+def _secondary_argv(argv, n: int):
+    """Child argv for the N-1 spawned cooperating workers: the original
+    command line minus the ``--workers`` spawn directive (a child must
+    not spawn grandchildren) plus the internal secondary marker."""
+    child = []
+    skip = False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok == "--workers":
+            skip = True
+            continue
+        if tok.startswith("--workers="):
+            continue
+        child.append(tok)
+    child.append("--exec-secondary")
+    return [child] * n
+
+
 def _run_sharded(args, argv, trace_path, report_path, t_start, t0) -> int:
     """Route through the streaming shard runner (racon_tpu.exec)."""
+    import subprocess
+
     from .exec import ShardRunner, parse_ram
 
+    workers = max(1, args.workers)
+    secondary = bool(args.exec_secondary)
+    children = []
     try:
         runner = ShardRunner(
             args.sequences, args.overlaps, args.target_sequences,
@@ -183,12 +222,43 @@ def _run_sharded(args, argv, trace_path, report_path, t_start, t0) -> int:
             include_unpolished=args.include_unpolished,
             n_shards=args.shards,
             max_ram_bytes=parse_ram(args.max_ram) if args.max_ram else 0,
-            resume=args.resume, work_dir=args.shard_dir)
-        runner.run(sys.stdout.buffer)
+            resume=args.resume, work_dir=args.shard_dir,
+            secondary=secondary, defer_cleanup=workers > 1)
+        if workers > 1 and not secondary:
+            # the secondaries poll for the manifest this process is
+            # about to publish, then start claiming shards; their
+            # merged-FASTA stream stays empty by construction
+            for child_argv in _secondary_argv(argv, workers - 1):
+                children.append(subprocess.Popen(
+                    [sys.executable, "-m", "racon_tpu"] + child_argv,
+                    stdout=subprocess.DEVNULL))
+        if secondary:
+            with open(os.devnull, "wb") as sink:
+                runner.run(sink)
+        else:
+            runner.run(sys.stdout.buffer)
     except (ValueError, RuntimeError, OSError) as e:
         print(f"[racon::] error: {e}", file=sys.stderr)
+        for proc in children:
+            proc.terminate()
         _finish_obs(trace_path, report_path, "exec", argv, t_start, t0)
         return 1
+    for proc in children:
+        # all shards were terminal before our run() returned, so the
+        # secondaries are draining their last poll; reap them before
+        # the work-dir cleanup pulls the manifest out from under them.
+        # A wedged secondary must not fail an already-successful run
+        # (the merged FASTA is on stdout): kill it and move on.
+        try:
+            proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            print("[racon::] warning: a secondary worker did not exit "
+                  "after the run completed — killing it",
+                  file=sys.stderr)
+            proc.kill()
+            proc.wait()
+    if workers > 1 and not secondary:
+        runner.cleanup_work_dir()
     _finish_obs(trace_path, report_path, "exec", argv, t_start, t0,
                 shards=runner.summary.get("shards"))
     return 0
@@ -204,7 +274,8 @@ def main(argv=None) -> int:
     t_start = time.time()
     t0 = time.perf_counter()
 
-    if args.shards or args.max_ram or args.resume or args.shard_dir:
+    if args.shards or args.max_ram or args.resume or args.shard_dir \
+            or args.workers > 1 or args.exec_secondary:
         return _run_sharded(args, list(argv), trace_path, report_path,
                             t_start, t0)
 

@@ -47,7 +47,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from .. import flags, obs, sanitize
+from .. import faults, flags, obs, sanitize
 from ..io import parsers
 from ..obs import metrics
 from ..utils.logger import Logger
@@ -72,7 +72,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     consensus_batches: int = 1,
                     banded: bool = False, *, aligner=None, consensus=None,
                     window_type=None, prefiltered_overlaps: bool = False,
-                    evict_reads: bool = False) -> "Polisher":
+                    evict_reads: bool = False,
+                    stall_escalation: bool = False) -> "Polisher":
     """Factory with the reference's validation rules
     (``polisher.cpp:62-133``). ``aligner_batches``/``consensus_batches``
     are the accelerator batch counts (reference ``-c N`` /
@@ -89,8 +90,12 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
     globally filtered (the runner's index pass applied the
     best-per-query-group rule over the FULL file — re-running it on a
     shard's subsequence could merge groups split in the original
-    stream), and ``evict_reads`` releases read payloads the moment
-    their window layers are assembled."""
+    stream), ``evict_reads`` releases read payloads the moment
+    their window layers are assembled, and ``stall_escalation`` arms
+    the sanitizer queue watchdog's second-timeout escalation (a
+    persistent stall fails the run with a ``stall``-class
+    :class:`racon_tpu.faults.StallError` for the runner's degradation
+    ladder — standalone runs keep the passive dump-only watchdog)."""
     if not isinstance(type_, PolisherType):
         raise ValueError("invalid polisher type")
     if window_length <= 0:
@@ -111,7 +116,8 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
                     banded, aligner=aligner, consensus=consensus,
                     window_type=window_type,
                     prefiltered_overlaps=prefiltered_overlaps,
-                    evict_reads=evict_reads)
+                    evict_reads=evict_reads,
+                    stall_escalation=stall_escalation)
 
 
 class Polisher:
@@ -121,7 +127,8 @@ class Polisher:
                  aligner_backend="auto", consensus_backend="auto",
                  aligner_batches=1, consensus_batches=1, banded=False,
                  aligner=None, consensus=None, window_type=None,
-                 prefiltered_overlaps=False, evict_reads=False):
+                 prefiltered_overlaps=False, evict_reads=False,
+                 stall_escalation=False):
         self.sequences_path = sequences_path
         self.overlaps_path = overlaps_path
         self.target_path = target_path
@@ -142,6 +149,7 @@ class Polisher:
         self._window_type_override = window_type
         self.prefiltered_overlaps = prefiltered_overlaps
         self.evict_reads = evict_reads
+        self.stall_escalation = stall_escalation
         self.logger = Logger()
 
         self.sequences: List[Sequence] = []
@@ -727,9 +735,34 @@ class Polisher:
         ranges: "Queue" = Queue(maxsize=4)  # bounded in-flight depth
         failure: List[BaseException] = []
         # sanitizer: stall monitor over the bounded queue — a deadlocked
-        # producer/consumer pair dumps all thread stacks instead of
-        # hanging silently (None unless RACON_TPU_SANITIZE=1)
-        watchdog = sanitize.queue_watchdog("init->polish queue")
+        # producer/consumer pair dumps all thread stacks (first
+        # timeout), then fails the run with a stall-class fault (second
+        # timeout) so the shard runner's ladder can retry/quarantine the
+        # shard instead of hanging forever (None unless
+        # RACON_TPU_SANITIZE=1). A consumer wedged inside device
+        # compute cannot be unblocked from in-process — the lease TTL
+        # covers that across workers; this escalation covers the wedged
+        # producer / deadlocked-queue shapes.
+        stall_mark = object()
+
+        def escalate():
+            failure.append(faults.StallError(
+                "init->polish queue made no progress past the "
+                "escalation timeout — failing the attempt with a "
+                "stall-class fault"))
+            from queue import Empty, Full
+            try:  # unblock a producer waiting on a full queue
+                ranges.get_nowait()
+            except Empty:  # graftlint: disable=swallowed-exception (best-effort unblock)
+                pass
+            try:  # unblock a consumer waiting on an empty queue
+                ranges.put_nowait(stall_mark)
+            except Full:  # graftlint: disable=swallowed-exception (best-effort unblock)
+                pass
+
+        watchdog = sanitize.queue_watchdog(
+            "init->polish queue",
+            escalate_cb=escalate if self.stall_escalation else None)
 
         def emit_range(a, b):
             if watchdog is not None:
@@ -796,7 +829,14 @@ class Polisher:
                     metrics.set_gauge("queue.depth", ranges.qsize())
                     if watchdog is not None:
                         watchdog.beat()
+                    if item is stall_mark:
+                        raise (failure[0] if failure else
+                               faults.StallError("init->polish queue "
+                                                 "stall escalation"))
                     if item is None:
+                        if failure and isinstance(failure[0],
+                                                  faults.StallError):
+                            raise failure[0]
                         break
                     a, b = item
                     if b > a:
@@ -832,7 +872,14 @@ class Polisher:
                     for a, b in fed_ranges:
                         polished[a:b] = flags_all[pos:pos + (b - a)]
                         pos += b - a
-        except BaseException:
+        except BaseException as e:
+            # a stall escalation means the producer (or the queue) is
+            # wedged: draining/joining would hang right back — abandon
+            # the daemon thread and propagate so the ladder can degrade
+            # the shard (a fresh attempt builds a fresh polisher; the
+            # wedged thread touches only this object's state)
+            if isinstance(e, faults.StallError):
+                raise
             # a consensus fault mid-stream must not strand the producer
             # on the bounded queue: drain it and retire the thread
             # before propagating, else the daemon thread pins the whole

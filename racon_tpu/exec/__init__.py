@@ -15,21 +15,29 @@ feasible and survivable:
   a resident-footprint cost model;
 - :mod:`.runner` — streams each shard through the existing
   ``Polisher.run()`` init->polish pipeline (engines reused across shards,
-  consumed reads evicted), emits atomic per-shard part files, retries a
-  failed shard once on the CPU engines and quarantines it with a logged
-  reason instead of killing the run, then merges parts back into
-  target-file order on stdout;
-- :mod:`.manifest` — the fsync'd JSON checkpoint that makes ``--resume``
-  skip completed shards and re-run only the interrupted one;
-- :mod:`.heartbeat` — the long-run progress line (shard i/N, Mbp/s, peak
-  RSS, jit-retrace counters).
+  consumed reads evicted), emits atomic per-shard part files (size +
+  CRC32 recorded, verified before merge), degrades a failed shard down
+  the per-fault-class ladder (backoff -> OOM backpressure -> CPU
+  engines -> quarantine) instead of killing the run, then merges parts
+  back into target-file order on stdout;
+- :mod:`.lease` — O_EXCL per-shard lease files with mtime heartbeats
+  and TTL expiry, so N concurrent workers (``--workers``, or separate
+  processes sharing the work dir) drain one manifest and a dead
+  worker's shard is reclaimed;
+- :mod:`.manifest` — the fsync'd JSON checkpoint (plan snapshot +
+  authoritative per-shard state files) that makes ``--resume`` skip
+  completed shards and re-run only the interrupted one;
+- :mod:`.heartbeat` — the long-run progress line (worker, shard i/N,
+  Mbp/s, peak RSS, jit-retrace counters).
 
 The concluding contract, asserted in ``tests/test_exec.py`` and
-``bench.py``: multi-shard and kill-then-resume runs are byte-identical to
-the single-shot FASTA.
+``tests/test_faults.py``: multi-shard, kill-then-resume and
+multi-worker chaos runs are byte-identical to the single-shot FASTA.
 """
 
 from .index import RunIndex, build_index  # noqa: F401
-from .manifest import load_manifest, save_manifest  # noqa: F401
+from .lease import Lease, try_claim, worker_identity  # noqa: F401
+from .manifest import (load_manifest, load_shard_states,  # noqa: F401
+                       save_manifest)
 from .planner import ShardPlan, parse_ram, plan_shards  # noqa: F401
 from .runner import ShardRunner  # noqa: F401

@@ -64,8 +64,10 @@ def queue_summary_str() -> str:
 class Heartbeat:
     """Shared-state progress reporter for the shard runner."""
 
-    def __init__(self, n_shards: int, stream=None):
+    def __init__(self, n_shards: int, stream=None,
+                 worker: Optional[str] = None):
         self.n_shards = n_shards
+        self.worker = worker
         self._stream = stream if stream is not None else sys.stderr
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
@@ -104,7 +106,9 @@ class Heartbeat:
         with self._lock:
             done, mbp, phase = self._done, self._mbp, self._phase
         dt = max(1e-9, time.perf_counter() - self._t0)
-        print(f"[racon_tpu::exec] {tag}: shard {done}/{self.n_shards} "
+        who = f" [{self.worker}]" if self.worker else ""
+        print(f"[racon_tpu::exec] {tag}{who}: "
+              f"shard {done}/{self.n_shards} "
               f"({phase}) {mbp:.2f} Mbp in {dt:.1f}s "
               f"({mbp / dt:.4f} Mbp/s) "
               f"peak_rss={peak_rss_bytes() >> 20}MB "

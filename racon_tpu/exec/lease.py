@@ -1,0 +1,228 @@
+"""Shard leases: the O_EXCL claim files that let N workers drain one
+manifest.
+
+One lease file per shard (``lease_0007.json``) next to the manifest.
+The protocol rides entirely on portable filesystem atomics, so it works
+for concurrent processes on one host and for workers on different hosts
+sharing the work directory over a network filesystem:
+
+- **claim** — ``open(O_CREAT | O_EXCL)``: exactly one claimant wins; the
+  payload records worker id, pid, host and claim time (fsync'd like
+  every other manifest artifact);
+- **heartbeat** — the owner refreshes the lease *mtime* every TTL/4
+  (:class:`LeaseKeeper` daemon thread).  The payload never rewrites, so
+  a heartbeat is one ``utime`` call;
+- **expiry** — a lease whose mtime is older than
+  ``RACON_TPU_EXEC_LEASE_TTL_S`` marks a dead worker.  A claimant
+  *breaks* it by renaming it to a unique tombstone first (rename is
+  atomic — exactly one of several racing claimants wins; the losers see
+  ENOENT and back off), then claims fresh via O_EXCL;
+- **release** — unlink on shard completion/quarantine.
+
+A worker that was presumed dead but is merely slow discovers the loss
+at its next heartbeat (``utime`` -> ENOENT) and stops treating the
+shard as its own; its in-flight part write stays harmless because part
+files are written tmp -> rename with worker-unique tmp names and every
+worker's output for a shard is byte-identical by the determinism
+contract.
+
+Every transition is published to the metrics registry
+(``lease.claimed`` / ``lease.expired`` / ``lease.reclaimed`` /
+``lease.lost``) so lease churn is visible in heartbeats and run
+reports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from .. import flags
+from ..obs import metrics
+from ..utils.logger import warn
+
+LEASE_PREFIX = "lease_"
+
+
+def worker_identity() -> str:
+    """This worker's id: ``RACON_TPU_WORKER`` override, else
+    ``hostname:pid``."""
+    override = flags.get_str("RACON_TPU_WORKER").strip()
+    if override:
+        return override
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+def lease_ttl_s() -> float:
+    return max(0.05, flags.get_float("RACON_TPU_EXEC_LEASE_TTL_S"))
+
+
+def lease_path(work_dir: str, shard_id: int) -> str:
+    return os.path.join(work_dir, f"{LEASE_PREFIX}{shard_id:04d}.json")
+
+
+class Lease:
+    """An owned shard lease; refresh with :meth:`heartbeat` (or start a
+    :class:`LeaseKeeper`), drop with :meth:`release`."""
+
+    def __init__(self, work_dir: str, shard_id: int, worker: str,
+                 claimed_unix: float = 0.0):
+        self.work_dir = work_dir
+        self.shard_id = shard_id
+        self.worker = worker
+        self.claimed_unix = claimed_unix
+        self.path = lease_path(work_dir, shard_id)
+        self.lost = threading.Event()
+        self._keeper: Optional["LeaseKeeper"] = None
+
+    def heartbeat(self) -> bool:
+        """Refresh the lease mtime; False (and ``lost`` set) when the
+        lease file is gone — another worker broke it after a missed
+        TTL, and this worker no longer owns the shard."""
+        try:
+            os.utime(self.path)
+            return True
+        except FileNotFoundError:
+            if not self.lost.is_set():
+                self.lost.set()
+                metrics.inc("lease.lost")
+                warn(f"lease on shard {self.shard_id} was broken by "
+                     f"another worker (missed heartbeats?) — "
+                     f"{self.worker} no longer owns it")
+            return False
+
+    def start_keeper(self) -> "Lease":
+        self._keeper = LeaseKeeper(self).start()
+        return self
+
+    def release(self) -> None:
+        if self._keeper is not None:
+            self._keeper.stop()
+            self._keeper = None
+        if self.lost.is_set():
+            return  # the file on disk is the reclaimer's lease, not ours
+        # unlink only what is provably still OUR lease: a broken-and-
+        # reclaimed shard has a new lease at the same path, and deleting
+        # it would expose the reclaimer's shard to double-claims
+        info = read_lease(self.work_dir, self.shard_id)
+        if info is not None and (
+                info.get("worker") != self.worker
+                or info.get("pid") != os.getpid()
+                or info.get("claimed_unix") != self.claimed_unix):
+            self.lost.set()
+            return
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class LeaseKeeper:
+    """Daemon thread refreshing a lease's mtime every TTL/4 — the
+    worker's liveness signal. Stops itself once the lease is lost."""
+
+    def __init__(self, lease: Lease):
+        self.lease = lease
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "LeaseKeeper":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"racon-lease-{self.lease.shard_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+
+    def _run(self) -> None:
+        interval = lease_ttl_s() / 4.0
+        while not self._stop.wait(interval):
+            if not self.lease.heartbeat():
+                return
+
+
+def read_lease(work_dir: str, shard_id: int) -> Optional[dict]:
+    """The lease payload (or None when absent/torn) — observability
+    only; claims never trust the payload, only O_EXCL and mtime."""
+    try:
+        with open(lease_path(work_dir, shard_id), "rb") as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def _pid_alive(pid) -> bool:
+    """Liveness probe for a same-host lease owner; unknown/unreadable
+    pids count as alive (the TTL is then the only authority)."""
+    if not isinstance(pid, int) or pid <= 0:
+        return True
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def try_claim(work_dir: str, shard_id: int, worker: str,
+              ttl_s: Optional[float] = None) -> Optional[Lease]:
+    """Attempt to claim a shard. Returns an owned :class:`Lease` (with
+    the heartbeat keeper already running), or None when another worker
+    holds a live lease. A lease past its TTL is broken (rename to a
+    tombstone — atomic, one winner) and reclaimed; a lease whose owner
+    ran on *this* host and whose pid is gone is broken immediately —
+    kill-then-resume must not idle out a whole TTL when the kernel
+    already knows the owner died."""
+    ttl = lease_ttl_s() if ttl_s is None else ttl_s
+    path = lease_path(work_dir, shard_id)
+    try:
+        st = os.stat(path)
+    except FileNotFoundError:
+        pass
+    else:
+        if time.time() - st.st_mtime <= ttl:
+            info = read_lease(work_dir, shard_id)
+            if not (info is not None
+                    and info.get("host") == socket.gethostname()
+                    and not _pid_alive(info.get("pid"))):
+                return None
+        tomb = f"{path}.stale.{os.getpid()}.{time.monotonic_ns()}"
+        try:
+            os.rename(path, tomb)
+        except OSError:
+            return None  # a racing claimant broke it first
+        try:
+            os.unlink(tomb)
+        except OSError:  # graftlint: disable=swallowed-exception (tombstone cleanup is best-effort)
+            pass
+        metrics.inc("lease.expired")
+        warn(f"lease on shard {shard_id} expired "
+             f"(no heartbeat for > {ttl:.1f}s) — {worker} is breaking "
+             f"it and reclaiming the shard")
+    claimed_unix = round(time.time(), 3)
+    payload = json.dumps({
+        "worker": worker, "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "claimed_unix": claimed_unix,
+    }, indent=1).encode()
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return None
+    try:
+        os.write(fd, payload)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    metrics.inc("lease.claimed")
+    return Lease(work_dir, shard_id, worker,
+                 claimed_unix=claimed_unix).start_keeper()

@@ -1,31 +1,49 @@
-"""Checkpoint manifest: the fsync'd JSON record that makes shard runs
-survivable.
+"""Checkpoint manifest: the fsync'd JSON records that make shard runs
+survivable — and, since round 12, shareable between workers.
 
-Write protocol (crash-ordering matters more than speed here — the
-manifest is written once per shard transition):
+Write protocol (crash-ordering matters more than speed here — state is
+written once per shard transition):
 
-1. part files are written to ``<part>.tmp``, fsync'd, then
+1. part files are written to ``<part>.tmp.<worker>``, fsync'd, then
    ``os.replace``d into place — a part file either exists complete or
-   not at all;
-2. the manifest is then rewritten the same way (tmp + fsync + atomic
-   replace + directory fsync), so it never claims a part that a crash
-   could have torn.
+   not at all (worker-unique tmp names keep a presumed-dead worker's
+   straggler write from tearing a reclaimer's; both rename identical
+   bytes);
+2. the owning worker then writes the shard's **state file**
+   (``state_0007.json``, same tmp + fsync + atomic replace + directory
+   fsync) — the authoritative per-shard record. Only the lease owner
+   ever writes a shard's state file, so state writes never race;
+3. the worker finally rewrites ``manifest.json`` as a *merged snapshot*
+   (base plan/fingerprint overlaid with every state file read just
+   before the write). Concurrent snapshot writes can interleave, which
+   is benign: the snapshot is the observability/resume surface, the
+   state files are the truth, and the next transition's snapshot
+   converges.
 
-``--resume`` trusts a shard exactly when the manifest says ``done`` AND
-the recorded part file exists with the recorded size. A corrupt or
-truncated manifest (the seeded-recovery test truncates one mid-object)
-is treated as absent: the run replans and re-executes every shard —
-correct output always beats salvaged work. A fingerprint of the inputs,
-parameters and the plan itself guards against resuming into a different
-run's directory.
+``--resume`` trusts a shard exactly when its merged record says
+``done`` AND the recorded part file exists with the recorded size (the
+pre-merge verification pass additionally re-reads every part against
+its recorded CRC before a single byte is concatenated). A corrupt or
+truncated manifest is treated as absent: the run replans and re-executes
+every shard — correct output always beats salvaged work. A fingerprint
+of the inputs, parameters and the plan itself guards against resuming
+into a different run's directory.
+
+Multi-worker bootstrap: :func:`create_manifest_if_absent` publishes the
+plan under an O_EXCL ``plan.lock`` (single writer; losers poll-adopt),
+so exactly one of N concurrently-starting workers plans the run — even
+over a corrupt leftover manifest — and every other worker adopts that
+stored plan, the same adoption rule ``--resume`` uses.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Optional
+import time
+from typing import Dict, Optional
 
+from .. import faults
 from ..utils.logger import warn
 
 MANIFEST_NAME = "manifest.json"
@@ -33,7 +51,8 @@ MANIFEST_NAME = "manifest.json"
 # durable-write protocol; schema in racon_tpu/obs/report.py) — future
 # service-mode job accounting reads shard rows from here
 REPORT_NAME = "run_report.json"
-VERSION = 1
+STATE_PREFIX = "state_"
+VERSION = 2
 
 DONE = "done"
 QUARANTINED = "quarantined"
@@ -51,7 +70,11 @@ def fsync_dir(path: str) -> None:
 
 
 def atomic_write(path: str, data: bytes) -> None:
-    tmp = path + ".tmp"
+    faults.check("manifest.write")
+    # tmp names are worker-unique (pid) AND call-unique (monotonic ns):
+    # threads of one process writing the same target must not race each
+    # other's replace
+    tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
@@ -60,10 +83,143 @@ def atomic_write(path: str, data: bytes) -> None:
     fsync_dir(os.path.dirname(path) or ".")
 
 
+def durable_write(path: str, data: bytes, retries: int = 3) -> None:
+    """:func:`atomic_write` with a short transient-I/O retry: a blip
+    (EINTR, momentary ENOSPC, NFS stall — or an injected
+    ``manifest.write`` fault) on a *checkpoint* write must not kill a
+    run whose actual work succeeded. Deterministic faults and exhausted
+    retries still raise."""
+    delay = 0.05
+    for k in range(retries + 1):
+        try:
+            atomic_write(path, data)
+            return
+        except OSError as e:
+            if k >= retries or \
+                    faults.classify(e) != faults.CLASS_TRANSIENT:
+                raise
+            warn(f"transient fault writing {os.path.basename(path)} "
+                 f"({e}) — retrying in {delay:.2f}s")
+            time.sleep(delay)
+            delay *= 2
+
+
 def save_manifest(work_dir: str, manifest: dict) -> None:
     manifest = dict(manifest, version=VERSION)
-    atomic_write(os.path.join(work_dir, MANIFEST_NAME),
-                 json.dumps(manifest, indent=1).encode())
+    durable_write(os.path.join(work_dir, MANIFEST_NAME),
+                  json.dumps(manifest, indent=1).encode())
+
+
+_PLAN_LOCK_STALE_S = 10.0
+
+
+def create_manifest_if_absent(work_dir: str, manifest: dict) -> dict:
+    """Publish ``manifest`` only if no *valid* manifest exists yet;
+    returns the manifest actually on disk — ours, or the one a
+    concurrently-starting worker won the race with (whose stored plan
+    the caller must adopt). Exactly ONE plan ever wins, including over
+    a corrupt leftover manifest: publication happens under an O_EXCL
+    ``plan.lock`` (single writer; a lock older than
+    ``_PLAN_LOCK_STALE_S`` marks a dead publisher and is broken), and
+    losers poll until the winner's manifest is readable — two workers
+    each installing their own plan would cut parts by different shard
+    maps against one merge."""
+    path = os.path.join(work_dir, MANIFEST_NAME)
+    lock = os.path.join(work_dir, "plan.lock")
+    deadline = time.monotonic() + 60.0
+    while True:
+        existing = load_manifest(work_dir)
+        if existing is not None:
+            return existing
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                         0o644)
+        except FileExistsError:
+            try:  # a publisher that died holding the lock must not
+                  # wedge every later worker: break a stale lock via
+                  # atomic rename-to-tombstone (one winner — a blind
+                  # unlink could delete a NEW lock created between our
+                  # stat and the unlink, letting two workers publish)
+                if time.time() - os.stat(lock).st_mtime > \
+                        _PLAN_LOCK_STALE_S:
+                    os.rename(lock, f"{lock}.stale.{os.getpid()}."
+                                    f"{time.monotonic_ns()}")
+            except OSError:  # graftlint: disable=swallowed-exception (another worker broke/released it first)
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no worker managed to publish a valid manifest "
+                    f"in {work_dir} (plan.lock contended for 60s)")
+            time.sleep(0.02)
+            continue
+        os.close(fd)
+        try:
+            existing = load_manifest(work_dir)
+            if existing is not None:
+                return existing  # published while we took the lock
+            out = dict(manifest, version=VERSION)
+            atomic_write(path, json.dumps(out, indent=1).encode())
+            return out
+        finally:
+            try:
+                os.unlink(lock)
+            except FileNotFoundError:
+                pass
+
+
+# ----------------------------------------------------- per-shard state
+
+def state_path(work_dir: str, shard_id: int) -> str:
+    return os.path.join(work_dir, f"{STATE_PREFIX}{shard_id:04d}.json")
+
+
+def save_shard_state(work_dir: str, entry: dict) -> None:
+    """Durably record one shard's authoritative state (lease owner
+    only — single-writer by protocol)."""
+    durable_write(state_path(work_dir, int(entry["id"])),
+                  json.dumps(entry, indent=1).encode())
+
+
+def load_shard_state(work_dir: str, shard_id: int) -> Optional[dict]:
+    """One shard's state record (None when absent/torn) — the per-claim
+    re-check reads just this file instead of scanning the directory."""
+    try:
+        with open(state_path(work_dir, shard_id), "rb") as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+def load_shard_states(work_dir: str) -> Dict[int, dict]:
+    """Every readable shard state file, by shard id (a torn state file
+    is skipped with a warning — the shard simply counts as pending and
+    re-runs, the same correct-over-salvaged rule the manifest uses)."""
+    out: Dict[int, dict] = {}
+    try:
+        names = os.listdir(work_dir)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(STATE_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(work_dir, name), "rb") as f:
+                entry = json.loads(f.read())
+            out[int(entry["id"])] = entry
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            warn(f"shard state {name} is corrupt ({type(e).__name__}: "
+                 f"{e}) — treating the shard as pending")
+    return out
+
+
+def merge_states(manifest: dict, states: Dict[int, dict]) -> dict:
+    """Overlay authoritative per-shard state records onto the manifest's
+    shard entries (in place; also returns it)."""
+    for i, entry in enumerate(manifest["shards"]):
+        st = states.get(int(entry["id"]))
+        if st is not None and st.get("contigs") == entry.get("contigs"):
+            manifest["shards"][i] = dict(st)
+    return manifest
 
 
 def load_manifest(work_dir: str) -> Optional[dict]:

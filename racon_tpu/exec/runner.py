@@ -1,19 +1,38 @@
-"""ShardRunner: stream a polishing run shard-by-shard with checkpoints.
+"""ShardRunner: crash-safe, multi-worker streaming of a polishing run.
 
-Per shard: extract the shard's inputs from the original files by byte
-range (targets verbatim, the globally-filtered overlap lines verbatim —
-MHAP ids rewritten to shard-local ordinals — and exactly the reads those
-overlaps reference), run the existing ``Polisher.run()`` init->polish
-pipeline on them (device engines are REUSED across shards so jit caches
-and warm-up compiles pay once; consumed reads are evicted the moment
-their layers are assembled), write the polished FASTA to an atomic part
-file, and record it in the fsync'd manifest. A failed shard (device
-fault, sanitizer trip, OOM-adjacent allocation failure) is retried once
-on the CPU consensus/aligner engines and quarantined with a logged
-reason instead of killing the run. Completed parts are finally merged
-back into target-file order, which makes the output byte-identical to a
-single-shot run — the invariance proof lives in ``tests/test_exec.py``
-and ``bench.py``.
+Round 9 made one process stream a run shard-by-shard with checkpoints;
+round 12 makes the manifest a *coordination point*: N concurrent
+runners (``--workers N``, or independently launched ``racon`` processes
+pointed at the same ``--shard-dir`` — same host or hosts sharing the
+directory) drain one manifest together.
+
+- **Leases** (:mod:`.lease`): a worker claims a shard by creating its
+  ``lease_NNNN.json`` with ``O_EXCL`` and keeps it alive by refreshing
+  the file's mtime; a worker that dies stops heartbeating, its lease
+  expires after ``RACON_TPU_EXEC_LEASE_TTL_S``, and another worker
+  breaks the lease and reclaims the shard. Parts are written
+  tmp->rename with worker-unique tmp names and shard output is
+  deterministic, so kill-then-reclaim keeps the merged FASTA
+  byte-identical (the chaos soak in ``tests/test_faults.py`` proves
+  it under seeded SIGKILLs and injected faults).
+- **Degradation ladder**: a failed shard attempt is classified
+  (:func:`racon_tpu.faults.classify`) and degraded per class —
+  ``transient-io`` retries the same engine under exponential backoff
+  with deterministic jitter; ``device-oom`` applies memory
+  backpressure (the consensus engine halves its pair-arena/group
+  capacity and the shard re-dispatches on the device); only then come
+  the CPU engines, and quarantine is the last rung. Every attempt is
+  recorded in the shard's manifest entry and the run report's
+  ``faults`` section.
+- **Part durability**: each completed part records its byte size and
+  CRC32; the pre-merge verification pass re-reads every part and
+  re-queues a truncated/corrupt one instead of emitting a corrupt
+  assembly.
+
+Completed parts finally merge back into target-file order, which makes
+the output byte-identical to a single-shot run — the invariance proofs
+live in ``tests/test_exec.py``, ``tests/test_faults.py`` and
+``bench.py``.
 """
 
 from __future__ import annotations
@@ -24,20 +43,29 @@ import os
 import shutil
 import sys
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import flags, obs
+from .. import faults, flags, obs
 from ..core.backends import make_aligner, make_consensus
 from ..core.polisher import PolisherType, create_polisher
 from ..io import parsers
 from ..obs import metrics, report as obs_report
 from ..utils.logger import warn
 from . import heartbeat as hb
+from . import lease as lease_mod
 from . import manifest as mf
 from .index import RunIndex, build_index
 from .planner import ShardPlan, plan_shards
+
+# verification/re-queue rounds before a persistently-corrupt part is a
+# hard error (each round re-polishes the shard from scratch)
+_MAX_VERIFY_ROUNDS = 3
+# how long a secondary worker waits for the primary to publish the
+# manifest before giving up
+_SECONDARY_MANIFEST_WAIT_S = 120.0
 
 
 def _eprint(msg: str) -> None:
@@ -53,18 +81,13 @@ def _plain_ext(path: str, candidates, default: str) -> str:
     return default
 
 
-def _fault_spec() -> Tuple[Optional[int], bool]:
-    """(shard_id, every_attempt) from RACON_TPU_EXEC_FAULT_SHARD."""
-    v = flags.get_str("RACON_TPU_EXEC_FAULT_SHARD").strip()
-    if not v:
-        return None, False
-    if v.endswith("*"):
-        return int(v[:-1]), True
-    return int(v), False
+def _terminal(entry: dict) -> bool:
+    return entry.get("status") in (mf.DONE, mf.QUARANTINED)
 
 
 class ShardRunner:
-    """Bounded-memory, checkpointed drive of the polishing pipeline."""
+    """Bounded-memory, checkpointed, lease-coordinated drive of the
+    polishing pipeline."""
 
     def __init__(self, sequences: str, overlaps: str, target_sequences: str,
                  *, type_: PolisherType = PolisherType.C,
@@ -77,7 +100,9 @@ class ShardRunner:
                  include_unpolished: bool = False, n_shards: int = 0,
                  max_ram_bytes: int = 0, max_target_bytes: int = 0,
                  resume: bool = False, work_dir: Optional[str] = None,
-                 keep_work_dir: Optional[bool] = None):
+                 keep_work_dir: Optional[bool] = None,
+                 merge: bool = True, secondary: bool = False,
+                 defer_cleanup: bool = False):
         self.sequences = os.path.abspath(sequences)
         self.overlaps = os.path.abspath(overlaps)
         self.target_sequences = os.path.abspath(target_sequences)
@@ -98,10 +123,20 @@ class ShardRunner:
         self.max_ram_bytes = max_ram_bytes
         self.max_target_bytes = max_target_bytes
         self.resume = resume
+        # merge=False / secondary=True: a cooperating drain-only worker
+        # (spawned by --workers, or launched by hand): it claims and
+        # polishes shards but emits no merged FASTA, adopts the
+        # primary's manifest instead of planning its own, and never
+        # cleans the shared work dir
+        self.merge = merge and not secondary
+        self.secondary = secondary
+        self.defer_cleanup = defer_cleanup
+        self.worker = lease_mod.worker_identity()
         # an explicit work dir is the user's to keep (resume workflows);
-        # a derived one is removed after a fully successful run
+        # a derived one is removed after a fully successful run.
+        # Secondary workers never remove the shared directory.
         self.keep_work_dir = (keep_work_dir if keep_work_dir is not None
-                              else work_dir is not None)
+                              else (work_dir is not None or secondary))
         self.work_dir = os.path.abspath(work_dir or self.derive_work_dir())
         self.index: Optional[RunIndex] = None
         self.plan: Optional[ShardPlan] = None
@@ -109,12 +144,17 @@ class ShardRunner:
         self.report: Dict = {}     # obs run report (also in work_dir)
         self._engines = None       # (aligner, consensus) — reused per shard
         self._cpu_engines = None   # lazy retry pair
+        self._retry_quarantined: set = set()  # resume: claimable again
+        self._initially_done: set = set()     # resume-skip bookkeeping
+        self._announced: set = set()
+        self._mbp_done = 0.0
 
     # ------------------------------------------------------------ identity
 
     def derive_work_dir(self) -> str:
         """Deterministic default work dir: same inputs + parameters =>
-        same directory, so ``--resume`` needs no extra bookkeeping."""
+        same directory, so ``--resume`` (and cooperating workers) need
+        no extra bookkeeping."""
         h = hashlib.sha1()
         for part in (self.sequences, self.overlaps, self.target_sequences,
                      self.type.name, self.window_length,
@@ -137,9 +177,10 @@ class ShardRunner:
     # ----------------------------------------------------------------- run
 
     def run(self, out) -> Dict:
-        """Execute (or resume) the full sharded run, writing the merged
-        polished FASTA to the binary stream ``out``. Returns the summary
-        dict (also kept as :attr:`summary`)."""
+        """Execute (or resume / join) the full sharded run, writing the
+        merged polished FASTA to the binary stream ``out`` (primary
+        workers only). Returns the summary dict (also kept as
+        :attr:`summary`)."""
         t0 = time.perf_counter()
         t_start = time.time()
         # run boundary: drop per-run metrics so a second in-process run
@@ -152,7 +193,8 @@ class ShardRunner:
         metrics.clear_run()
         obs.trace.activate()
         _eprint(f"indexing {os.path.basename(self.overlaps)} / "
-                f"{os.path.basename(self.sequences)}")
+                f"{os.path.basename(self.sequences)} "
+                f"(worker {self.worker})")
         with obs.span("exec.index"):
             self.index = build_index(self.sequences, self.overlaps,
                                      self.target_sequences, self.type,
@@ -164,43 +206,48 @@ class ShardRunner:
                                     self.max_target_bytes,
                                     base_rss=base_rss)
         os.makedirs(self.work_dir, exist_ok=True)
-        # a valid resume manifest ADOPTS the stored plan (a --max-ram
-        # plan depends on the planning process's live RSS, so this
-        # process could legitimately compute a different one — re-running
-        # completed shards over that would defeat --resume)
+        # a valid resume/adopted manifest carries the stored plan (a
+        # --max-ram plan depends on the planning process's live RSS, so
+        # this process could legitimately compute a different one —
+        # re-running completed shards over that would defeat --resume,
+        # and cooperating workers cutting parts by different plans
+        # would corrupt the merge)
         manifest = self._load_or_init_manifest()
         n = self.plan.n_shards
         total_mbp = sum(t.bases for t in self.index.targets) / 1e6
         _eprint(f"plan: {len(self.index.targets)} contigs "
                 f"({total_mbp:.2f} Mbp), {len(self.index.ov_start)} "
                 f"overlaps -> {n} shards (mode={self.plan.mode})")
-        beat = hb.Heartbeat(n).start()
-        mbp_done = 0.0
+        beat = hb.Heartbeat(n, worker=self.worker).start()
         try:
-            for si, shard in enumerate(self.plan.shards):
-                entry = manifest["shards"][si]
-                shard_mbp = sum(self.index.targets[ci].bases
-                                for ci in shard) / 1e6
-                if self._shard_is_done(entry):
-                    _eprint(f"resume: skipping completed shard {si} "
-                            f"({shard_mbp:.2f} Mbp)")
-                    mbp_done += shard_mbp
-                    beat.update(done=si + 1, mbp=mbp_done, phase="resume")
-                    continue
-                beat.update(done=si, phase="polishing")
-                # per-shard trace track: every shard's spans land on
-                # their own Perfetto row
-                with obs.track(f"shard {si}"), \
-                        obs.span("exec.shard", shard=si):
-                    self._run_shard(si, shard, entry, manifest, beat)
-                if entry["status"] == mf.DONE:
-                    mbp_done += shard_mbp
-                beat.update(done=si + 1, mbp=mbp_done)
-                beat.emit(f"shard {si} {entry['status']} "
-                          f"engine={entry.get('engine', '-')}")
-            beat.update(phase="merging")
-            with obs.span("exec.merge"):
-                self._merge_parts(manifest, out)
+            # only a worker that will MERGE verifies parts: it is the
+            # emitted assembly the CRC pass protects, and N workers
+            # each re-reading the whole part set would multiply the
+            # post-polish I/O for no additional safety
+            for round_no in range(_MAX_VERIFY_ROUNDS):
+                self._drain(manifest, beat)
+                bad = self._verify_parts(manifest) if self.merge else []
+                if not bad:
+                    break
+                for si in bad:
+                    self._requeue_shard(si, manifest,
+                                        "part verification failed")
+            else:
+                raise RuntimeError(
+                    f"parts still failing verification after "
+                    f"{_MAX_VERIFY_ROUNDS} re-polish rounds — refusing "
+                    f"to emit a corrupt assembly")
+            # one final fully-merged snapshot per worker: per-transition
+            # saves fold in only the owned entry (O(shards^2) avoidance),
+            # so the on-disk manifest converges to the all-states truth
+            # here, where the run's terminal picture is what matters
+            mf.merge_states(manifest,
+                            mf.load_shard_states(self.work_dir))
+            mf.save_manifest(self.work_dir, manifest)
+            if self.merge:
+                beat.update(phase="merging")
+                with obs.span("exec.merge"):
+                    self._merge_parts(manifest, out)
         finally:
             beat.stop()
 
@@ -208,9 +255,12 @@ class ShardRunner:
                        if e["status"] == mf.QUARANTINED]
         for e in quarantined:
             warn(f"shard {e['id']} quarantined: {e.get('reason')}")
+        mbp_done = sum(e.get("mbp", 0.0) for e in manifest["shards"]
+                       if e["status"] == mf.DONE)
         wall = time.perf_counter() - t0
         self.summary = {
             "n_shards": n, "mode": self.plan.mode,
+            "worker": self.worker,
             "mbp_total": round(total_mbp, 4),
             "mbp_polished": round(mbp_done, 4),
             "wall_s": round(wall, 2),
@@ -220,6 +270,8 @@ class ShardRunner:
             "budget_bytes": self.plan.budget_bytes,
             "quarantined": [e["id"] for e in quarantined],
             "consensus_pack": metrics.pack_summary(),
+            "faults": metrics.group("faults."),
+            "lease": metrics.group("lease."),
             "shards": [dict(e) for e in manifest["shards"]],
         }
         # machine-readable run report next to the manifest (same durable
@@ -231,11 +283,19 @@ class ShardRunner:
         self.report = obs_report.build_report(
             "exec", started_unix=t_start, wall_s=wall,
             shards=manifest["shards"])
-        mf.atomic_write(os.path.join(self.work_dir, mf.REPORT_NAME),
-                        json.dumps(self.report, indent=1).encode())
-        if not quarantined and not self.keep_work_dir:
-            shutil.rmtree(self.work_dir, ignore_errors=True)
+        mf.durable_write(os.path.join(self.work_dir, mf.REPORT_NAME),
+                         json.dumps(self.report, indent=1).encode())
+        if not self.defer_cleanup:
+            self.cleanup_work_dir()
         return self.summary
+
+    def cleanup_work_dir(self) -> None:
+        """Remove a derived work dir after a fully successful run (an
+        explicit/kept dir, a secondary worker, or a run with
+        quarantined shards leaves it in place)."""
+        if self.summary.get("quarantined") or self.keep_work_dir:
+            return
+        shutil.rmtree(self.work_dir, ignore_errors=True)
 
     # ------------------------------------------------------------ manifest
 
@@ -243,50 +303,309 @@ class ShardRunner:
         fingerprint = mf.input_fingerprint(
             (self.sequences, self.overlaps, self.target_sequences),
             self._params_fingerprint())
-        manifest = mf.load_manifest(self.work_dir) if self.resume else None
-        if manifest is not None and manifest["fingerprint"] != fingerprint:
-            warn("manifest fingerprint does not match this run's inputs/"
-                 "parameters — re-running every shard")
-            manifest = None
-        if manifest is not None:
-            stored = [list(map(int, e["contigs"]))
-                      for e in manifest["shards"]]
-            if sorted(ci for s in stored for ci in s) == \
-                    list(range(len(self.index.targets))):
-                self.plan.shards = stored  # the plan the parts were cut by
-            else:
-                warn("manifest shard plan does not cover this input's "
-                     "contigs — re-running every shard")
-                manifest = None
-        if not self.resume:
+        manifest = None
+        rejected = False
+        if self.secondary:
+            manifest = self._await_manifest(fingerprint)
+            if not self._adopt_plan(manifest):
+                raise RuntimeError(
+                    "the published manifest's shard plan does not "
+                    "cover this input — refusing to join it")
+        elif self.resume:
+            manifest = mf.load_manifest(self.work_dir)
+            if manifest is not None and \
+                    manifest["fingerprint"] != fingerprint:
+                warn("manifest fingerprint does not match this run's "
+                     "inputs/parameters — re-running every shard")
+                manifest, rejected = None, True
+            if manifest is not None and not self._adopt_plan(manifest):
+                manifest, rejected = None, True
+        if (not self.resume and not self.secondary) or rejected:
             self._clean_work_dir()
         if manifest is None:
-            manifest = {
+            fresh = {
                 "fingerprint": fingerprint,
                 "shards": [{"id": si, "contigs": list(map(int, shard)),
                             "status": mf.PENDING,
                             "part": f"part_{si:04d}.fasta"}
                            for si, shard in enumerate(self.plan.shards)],
             }
-            mf.save_manifest(self.work_dir, manifest)
+            # atomic create-if-absent: of N concurrently-starting
+            # workers exactly one publishes its plan; the losers adopt
+            # the winner's (identical inputs, possibly different
+            # --max-ram plan — the parts must all be cut by ONE plan)
+            manifest = mf.create_manifest_if_absent(self.work_dir, fresh)
+            if manifest is not fresh and not self._adopt_plan(manifest):
+                raise RuntimeError(
+                    "another worker published a manifest whose shard "
+                    "plan does not cover this input — refusing to "
+                    "join it")
+        # overlay the authoritative per-shard state files (they win
+        # over whatever snapshot the manifest holds)
+        mf.merge_states(manifest, mf.load_shard_states(self.work_dir))
+        for e in manifest["shards"]:
+            if e["status"] == mf.DONE:
+                # trusted for now; the pre-merge CRC verification pass
+                # re-queues any part that is missing/truncated/corrupt
+                self._initially_done.add(int(e["id"]))
+            elif e["status"] == mf.QUARANTINED and \
+                    (self.resume or self.secondary):
+                # a new run gets to retry what a previous run gave up on
+                self._retry_quarantined.add(int(e["id"]))
         return manifest
+
+    def _await_manifest(self, fingerprint) -> dict:
+        """Secondary workers adopt, never plan: poll until the primary
+        has published a manifest for these inputs."""
+        deadline = time.monotonic() + _SECONDARY_MANIFEST_WAIT_S
+        while True:
+            manifest = mf.load_manifest(self.work_dir)
+            if manifest is not None and \
+                    manifest["fingerprint"] == fingerprint:
+                return manifest
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"secondary worker {self.worker}: no manifest for "
+                    f"these inputs appeared in {self.work_dir} within "
+                    f"{_SECONDARY_MANIFEST_WAIT_S:.0f}s")
+            time.sleep(0.1)
+
+    def _adopt_plan(self, manifest: dict) -> bool:
+        """Adopt the stored shard plan (the one the parts were/will be
+        cut by); False when it does not cover this input's contigs."""
+        stored = [list(map(int, e["contigs"]))
+                  for e in manifest["shards"]]
+        if sorted(ci for s in stored for ci in s) == \
+                list(range(len(self.index.targets))):
+            self.plan.shards = stored
+            return True
+        warn("manifest shard plan does not cover this input's "
+             "contigs — re-running every shard")
+        return False
 
     def _clean_work_dir(self) -> None:
         """Drop recognized artifacts of a previous run (fresh, non-resume
-        runs must not trust stale parts)."""
+        runs must not trust stale parts) — including torn ``*.tmp.*``
+        leftovers of crashed atomic writes and lock/lease tombstones,
+        whose monotonic-ns names are never reused and would otherwise
+        litter a crash-retried work dir forever. Refuses to clean while
+        another worker holds a live lease: a plain (non ``--resume``)
+        launch into a shard dir with a run in progress must not destroy
+        its checkpoints."""
+        for name in os.listdir(self.work_dir):
+            if name.startswith(lease_mod.LEASE_PREFIX) \
+                    and name.endswith(".json"):
+                sid = name[len(lease_mod.LEASE_PREFIX):-len(".json")]
+                if not sid.isdigit():
+                    continue
+                probe = lease_mod.try_claim(self.work_dir, int(sid),
+                                            self.worker)
+                if probe is None:
+                    raise RuntimeError(
+                        f"{self.work_dir} has a live shard lease "
+                        f"({name}) — another worker is mid-run there. "
+                        f"Pass --resume to cooperate with it, or pick "
+                        f"a different --shard-dir.")
+                probe.release()  # dead leftover: claimable, hence safe
         for name in os.listdir(self.work_dir):
             path = os.path.join(self.work_dir, name)
-            if name == mf.MANIFEST_NAME or name.startswith("part_"):
-                os.unlink(path)
+            if name in (mf.MANIFEST_NAME, mf.REPORT_NAME) \
+                    or name.startswith(("part_", mf.STATE_PREFIX,
+                                        lease_mod.LEASE_PREFIX,
+                                        "plan.lock")) \
+                    or ".tmp." in name:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
             elif name.startswith("shard_") and os.path.isdir(path):
                 shutil.rmtree(path, ignore_errors=True)
 
-    def _shard_is_done(self, entry: dict) -> bool:
-        if entry.get("status") != mf.DONE:
-            return False
-        part = os.path.join(self.work_dir, entry["part"])
-        return (os.path.exists(part)
-                and os.path.getsize(part) == entry.get("bytes", -1))
+    def _save(self, entry: dict, manifest: dict) -> None:
+        """Durably record one owned shard's state, then refresh the
+        manifest snapshot. State files are authoritative and the
+        snapshot is advisory, so only the OWNED entry is folded in here
+        (it already sits in ``manifest["shards"]``); other workers'
+        newer states were merged at the top of the drain pass and
+        converge on their own transitions — re-reading every state file
+        per write would be O(shards^2) metadata I/O on the shared
+        filesystems multi-worker runs target."""
+        mf.save_shard_state(self.work_dir, entry)
+        mf.save_manifest(self.work_dir, manifest)
+
+    def _save_owned(self, entry: dict, manifest: dict, claim) -> None:
+        """Terminal-state write under lease-ownership proof: a worker
+        whose lease was broken (it stalled past the TTL and another
+        worker reclaimed the shard) must NOT write — the reclaimer owns
+        the state file now, and overwriting its ``done`` with our
+        late ``quarantined`` would silently drop the shard from the
+        merge. The part write that may have preceded this is harmless:
+        both workers' parts are byte-identical by determinism."""
+        if claim.lost.is_set() or not claim.heartbeat():
+            metrics.inc("lease.stale_write_suppressed")
+            warn(f"shard {entry['id']}: lease was broken while this "
+                 f"worker ran — discarding its late "
+                 f"{entry.get('status')} result (the reclaiming "
+                 f"worker's state stands)")
+            # reload the reclaimer's truth so our in-memory manifest
+            # does not carry the suppressed result forward
+            fresh = mf.load_shard_state(self.work_dir, int(entry["id"]))
+            if fresh is not None:
+                entry.clear()
+                entry.update(fresh)
+            return
+        self._save(entry, manifest)
+
+    # ---------------------------------------------------------- drain loop
+
+    def _drain(self, manifest: dict, beat) -> None:
+        """Claim-and-run until every shard is terminal: each pass walks
+        the plan, claims what it can, and runs what it claims; when
+        every remaining shard is leased by another live worker, poll —
+        a lease whose worker died expires after the TTL and the next
+        pass reclaims the shard."""
+        n = self.plan.n_shards
+        poll_s = max(0.05, flags.get_float("RACON_TPU_EXEC_POLL_S"))
+        while True:
+            progressed = False
+            waiting: List[int] = []
+            states = mf.load_shard_states(self.work_dir)
+            mf.merge_states(manifest, states)
+            for si, shard in enumerate(self.plan.shards):
+                entry = manifest["shards"][si]
+                if _terminal(entry) and si not in self._retry_quarantined:
+                    self._note_terminal(si, entry, beat)
+                    continue
+                claim = lease_mod.try_claim(self.work_dir, si,
+                                            self.worker)
+                if claim is None:
+                    waiting.append(si)
+                    continue
+                try:
+                    # re-check under the lease: the previous owner may
+                    # have finished between our state read and the claim
+                    fresh = mf.load_shard_state(self.work_dir, si)
+                    if fresh is not None:
+                        manifest["shards"][si] = entry = dict(fresh)
+                    if _terminal(entry) and \
+                            si not in self._retry_quarantined:
+                        self._note_terminal(si, entry, beat)
+                        continue
+                    self._retry_quarantined.discard(si)
+                    if entry.get("status") == mf.RUNNING:
+                        # stale-lease takeover of an abandoned shard
+                        metrics.inc("lease.reclaimed")
+                        entry["reclaimed"] = int(
+                            entry.get("reclaimed", 0)) + 1
+                        _eprint(f"reclaiming shard {si} abandoned by "
+                                f"worker {entry.get('worker', '?')}")
+                    beat.update(done=self._done_count(manifest),
+                                phase="polishing")
+                    with obs.track(f"shard {si}"), \
+                            obs.span("exec.shard", shard=si):
+                        self._run_shard(si, shard, entry, manifest,
+                                        beat, claim)
+                finally:
+                    claim.release()
+                progressed = True
+                self._note_terminal(si, entry, beat)
+                beat.emit(f"shard {si} {entry['status']} "
+                          f"engine={entry.get('engine', '-')}")
+            if not waiting and self._done_all(manifest):
+                return
+            if not progressed:
+                beat.update(phase=f"waiting on {len(waiting)} leased "
+                                  f"shard(s)")
+                time.sleep(poll_s)
+
+    def _done_count(self, manifest: dict) -> int:
+        return sum(_terminal(e) for e in manifest["shards"])
+
+    def _done_all(self, manifest: dict) -> bool:
+        mf.merge_states(manifest, mf.load_shard_states(self.work_dir))
+        return all(_terminal(e) for e in manifest["shards"])
+
+    def _note_terminal(self, si: int, entry: dict, beat) -> None:
+        if si in self._announced or not _terminal(entry):
+            return
+        self._announced.add(si)
+        if entry["status"] == mf.DONE:
+            self._mbp_done += sum(self.index.targets[ci].bases
+                                  for ci in self.plan.shards[si]) / 1e6
+        shard_mbp = sum(self.index.targets[ci].bases
+                        for ci in self.plan.shards[si]) / 1e6
+        if si in self._initially_done and self.resume:
+            _eprint(f"resume: skipping completed shard {si} "
+                    f"({shard_mbp:.2f} Mbp)")
+        elif entry.get("worker") not in (None, self.worker):
+            _eprint(f"shard {si} {entry['status']} by worker "
+                    f"{entry.get('worker')}")
+        beat.update(done=len(self._announced), mbp=self._mbp_done)
+
+    # ------------------------------------------------- verification/requeue
+
+    def _verify_parts(self, manifest: dict) -> List[int]:
+        """Re-read every done part against its recorded size and CRC32
+        (the durability net of the part protocol: a torn rename cannot
+        happen, but a disk that lied about fsync, a truncated copy or a
+        flipped bit can). Returns the shard ids whose parts fail."""
+        mf.merge_states(manifest, mf.load_shard_states(self.work_dir))
+        bad: List[int] = []
+        for entry in manifest["shards"]:
+            if entry["status"] != mf.DONE:
+                continue
+            part = os.path.join(self.work_dir, entry["part"])
+            try:
+                crc = 0
+                size = 0
+                with open(part, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        crc = zlib.crc32(chunk, crc)
+                        size += len(chunk)
+                ok = (size == entry.get("bytes")
+                      and crc == entry.get("crc32"))
+            except OSError:
+                ok = False
+            if not ok:
+                warn(f"part {entry['part']} failed verification "
+                     f"(recorded {entry.get('bytes')}B "
+                     f"crc32={entry.get('crc32')}) — re-queueing "
+                     f"shard {entry['id']} instead of merging a "
+                     f"corrupt assembly")
+                metrics.inc("faults.part_corrupt")
+                bad.append(int(entry["id"]))
+        return bad
+
+    def _requeue_shard(self, si: int, manifest: dict,
+                       why: str) -> None:
+        """Reset a shard to pending (under its lease, so concurrent
+        verifiers cannot double-reset) and let the drain loop re-run
+        it. The stale part file is deliberately left in place: the
+        re-run atomically replaces it with identical bytes, and another
+        worker concurrently mid-merge keeps reading its already-open
+        (old-inode) copy — an unlink here would hand that merger a
+        FileNotFoundError instead."""
+        claim = lease_mod.try_claim(self.work_dir, si, self.worker)
+        if claim is None:
+            return  # another worker is already handling it
+        try:
+            was = manifest["shards"][si]
+            entry = {"id": si,
+                     "contigs": list(map(int, self.plan.shards[si])),
+                     "status": mf.PENDING,
+                     "part": f"part_{si:04d}.fasta",
+                     "requeued": why}
+            manifest["shards"][si] = entry
+            self._save(entry, manifest)
+            if si in self._announced and was.get("status") == mf.DONE:
+                # keep the heartbeat honest: the re-run will re-add it
+                self._mbp_done = max(0.0, self._mbp_done - sum(
+                    self.index.targets[ci].bases
+                    for ci in self.plan.shards[si]) / 1e6)
+            self._announced.discard(si)
+            self._initially_done.discard(si)
+        finally:
+            claim.release()
 
     # ------------------------------------------------------ shard execution
 
@@ -308,65 +627,131 @@ class ShardRunner:
                                banded=self.banded))
         return self._engines
 
+    def _reduce_capacity(self) -> bool:
+        """Memory backpressure for a device-oom fault: halve the
+        consensus engine's pair-arena/group capacity so the re-dispatch
+        allocates half the working set (output bytes are invariant to
+        grouping). False once the engines can shrink no further (or
+        expose no knob — CPU engines)."""
+        if self._engines is None:
+            return False
+        reduced = False
+        for eng in self._engines:
+            shrink = getattr(eng, "reduce_capacity", None)
+            if shrink is not None and shrink():
+                reduced = True
+        return reduced
+
+    def _backoff_s(self, si: int, k: int) -> float:
+        """Exponential backoff with deterministic jitter: base * 2^k,
+        jittered ±25% by a hash of (worker, shard, attempt) — workers
+        that hit the same transient fault together fan out instead of
+        thundering back in lockstep, and a rerun replays exactly."""
+        base = max(0.0, flags.get_float("RACON_TPU_EXEC_BACKOFF_S"))
+        frac = zlib.crc32(f"{self.worker}:{si}:{k}".encode()) % 1000
+        return base * (2.0 ** k) * (0.75 + frac / 2000.0)
+
     def _run_shard(self, si: int, shard: List[int], entry: dict,
-                   manifest: dict, beat) -> None:
+                   manifest: dict, beat, claim) -> None:
         sleep_s = flags.get_float("RACON_TPU_EXEC_SLEEP_S")
         if sleep_s > 0 and si > 0:
             time.sleep(sleep_s)  # test hook: widen the kill window
-        entry["status"] = mf.RUNNING
-        mf.save_manifest(self.work_dir, manifest)
+        entry.update(status=mf.RUNNING, worker=self.worker)
+        # drop a previous incarnation's outcome fields (quarantine
+        # reason, attempt ladder, part stats) so the record describes
+        # THIS attempt's history only
+        for stale in ("requeued", "reason", "attempts", "engine",
+                      "bytes", "crc32"):
+            entry.pop(stale, None)
+        self._save(entry, manifest)
+        # chaos-soak site: a SIGKILL here leaves the shard RUNNING with
+        # a heartbeating-no-more lease — exactly the state another
+        # worker must detect, break and reclaim
+        faults.check("worker.kill")
         # per-shard attribution: the retrace gauges are process-wide, so
         # a shard that short-circuits (zero overlaps) must not inherit
         # the previous shard's compile churn as its own telemetry
         metrics.clear("retrace.")
         t0 = time.perf_counter()
-        with obs.span("exec.extract", shard=si):
-            paths = self._extract_shard(si, shard)
-        extract_s = time.perf_counter() - t0
-
-        fault_shard, fault_always = _fault_spec()
-        records: Optional[List[Tuple[bytes, bytes]]] = None
-        timings: Dict = {}
-        engine_used = "primary"
-        reason = None
-        for attempt, cpu in enumerate((False, True)):
-            try:
-                if si == fault_shard and (fault_always or attempt == 0):
-                    raise RuntimeError(
-                        "injected device-engine fault "
-                        "(RACON_TPU_EXEC_FAULT_SHARD)")
-                records, timings = self._polish_shard(paths, cpu=cpu)
-                engine_used = "cpu-retry" if cpu else "primary"
-                break
-            except Exception as e:
-                warn(f"shard {si} {'CPU retry' if cpu else 'attempt'} "
-                     f"failed: {type(e).__name__}: {e}")
-                if reason is None:
-                    reason = f"{type(e).__name__}: {e}"
-                else:
-                    reason += f"; cpu retry: {type(e).__name__}: {e}"
-
-        if records is None:
-            entry.update(status=mf.QUARANTINED, reason=reason,
-                         wall_s=round(time.perf_counter() - t0, 2))
-            mf.save_manifest(self.work_dir, manifest)
-            shutil.rmtree(os.path.dirname(paths["targets"]),
-                          ignore_errors=True)
-            return
 
         part = os.path.join(self.work_dir, entry["part"])
-        tmp = part + ".tmp"
-        with open(tmp, "wb") as f:
-            for name, data in records:
-                f.write(b">" + name + b"\n" + data + b"\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, part)
-        mf.fsync_dir(self.work_dir)
-
+        max_retries = max(0, flags.get_int("RACON_TPU_EXEC_RETRIES"))
+        attempts: List[dict] = []
+        transient_used = 0
+        tier_cpu = False
+        paths: Optional[Dict[str, str]] = None
+        extract_s = 0.0
+        timings: Dict = {}
+        part_stat: Optional[Tuple[int, int]] = None  # (bytes, crc32)
+        for attempt_no in range(64):  # ladder is finite by construction
+            try:
+                if paths is None:
+                    t_ext = time.perf_counter()
+                    with obs.span("exec.extract", shard=si):
+                        paths = self._extract_shard(si, shard)
+                    extract_s += time.perf_counter() - t_ext
+                faults.check("exec.polish", shard=si, attempt=attempt_no)
+                records, timings = self._polish_shard(paths,
+                                                      cpu=tier_cpu)
+                part_stat = self._write_part(part, records)
+                break
+            except Exception as e:
+                cls = faults.classify(e)
+                metrics.inc(f"faults.{cls}")
+                err = f"{type(e).__name__}: {e}"
+                att = {"n": attempt_no,
+                       "engine": "cpu" if tier_cpu else "primary",
+                       "class": cls, "error": err}
+                attempts.append(att)
+                if cls == faults.CLASS_TRANSIENT and \
+                        transient_used < max_retries:
+                    backoff = self._backoff_s(si, transient_used)
+                    att["action"] = "retry-backoff"
+                    att["backoff_s"] = round(backoff, 3)
+                    transient_used += 1
+                    metrics.add_time("exec.backoff_s", backoff)
+                    warn(f"shard {si} transient fault ({err}) — "
+                         f"retry {transient_used}/{max_retries} in "
+                         f"{backoff:.2f}s")
+                    if isinstance(e, OSError):
+                        paths = None  # re-extract after an I/O fault
+                    time.sleep(backoff)
+                elif cls == faults.CLASS_OOM and not tier_cpu and \
+                        self._reduce_capacity():
+                    att["action"] = "reduce-capacity"
+                    warn(f"shard {si} device OOM ({err}) — halved the "
+                         f"consensus arena/group capacity, "
+                         f"re-dispatching on the device")
+                elif not tier_cpu:
+                    tier_cpu = True
+                    att["action"] = "cpu-retry"
+                    warn(f"shard {si} attempt failed ({err}) — "
+                         f"retrying on the CPU engines")
+                else:
+                    att["action"] = "quarantine"
+                    warn(f"shard {si} CPU retry failed ({err}) — "
+                         f"quarantining")
+                    entry.update(
+                        status=mf.QUARANTINED,
+                        reason=self._reason(attempts),
+                        attempts=attempts, worker=self.worker,
+                        wall_s=round(time.perf_counter() - t0, 2))
+                    self._save_owned(entry, manifest, claim)
+                    self._drop_shard_inputs(paths)
+                    return
+        else:  # unreachable backstop: the ladder ends in break/return
+            entry.update(status=mf.QUARANTINED,
+                         reason=self._reason(attempts),
+                         attempts=attempts, worker=self.worker,
+                         wall_s=round(time.perf_counter() - t0, 2))
+            self._save_owned(entry, manifest, claim)
+            self._drop_shard_inputs(paths)
+            return
         entry.update(
-            status=mf.DONE, engine=engine_used,
-            bytes=os.path.getsize(part),
+            status=mf.DONE,
+            engine="cpu-retry" if tier_cpu else "primary",
+            worker=self.worker,
+            bytes=part_stat[0], crc32=part_stat[1],
             mbp=round(sum(self.index.targets[ci].bases
                           for ci in shard) / 1e6, 4),
             wall_s=round(time.perf_counter() - t0, 2),
@@ -374,11 +759,48 @@ class ShardRunner:
             timings=timings,
             retrace=metrics.group("retrace."),
             peak_rss_mb=hb.peak_rss_bytes() >> 20)
-        if reason is not None:
-            entry["reason"] = reason  # first attempt's fault, CPU-retried
-        mf.save_manifest(self.work_dir, manifest)
-        shutil.rmtree(os.path.dirname(paths["targets"]),
-                      ignore_errors=True)
+        if attempts:
+            # the per-attempt ladder record plus the round-9 summary
+            # string every fault-path test and operator greps for
+            entry["attempts"] = attempts
+            entry["reason"] = self._reason(attempts)
+        self._save_owned(entry, manifest, claim)
+        self._drop_shard_inputs(paths)
+
+    @staticmethod
+    def _reason(attempts: List[dict]) -> str:
+        parts = []
+        for a in attempts:
+            prefix = "cpu retry: " if a["engine"] == "cpu" else ""
+            parts.append(prefix + a["error"])
+        return "; ".join(parts)
+
+    @staticmethod
+    def _drop_shard_inputs(paths: Optional[Dict[str, str]]) -> None:
+        if paths is not None:
+            shutil.rmtree(os.path.dirname(paths["targets"]),
+                          ignore_errors=True)
+
+    def _write_part(self, part: str,
+                    records: List[Tuple[bytes, bytes]]) -> Tuple[int, int]:
+        """Durably write one part file (tmp + fsync + atomic rename,
+        worker-unique tmp name) and return its (byte size, CRC32) for
+        the manifest record the merge verifies against."""
+        faults.check("part.write")
+        tmp = f"{part}.tmp.{os.getpid()}"
+        crc = 0
+        size = 0
+        with open(tmp, "wb") as f:
+            for name, data in records:
+                blob = b">" + name + b"\n" + data + b"\n"
+                f.write(blob)
+                crc = zlib.crc32(blob, crc)
+                size += len(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, part)
+        mf.fsync_dir(self.work_dir)
+        return size, crc
 
     def _polish_shard(self, paths: Dict[str, str],
                       cpu: bool) -> Tuple[List[Tuple[bytes, bytes]], Dict]:
@@ -393,7 +815,8 @@ class ShardRunner:
             match=self.match, mismatch=self.mismatch, gap=self.gap,
             num_threads=self.num_threads, aligner=aligner,
             consensus=consensus, window_type=self.index.window_type,
-            prefiltered_overlaps=True, evict_reads=True)
+            prefiltered_overlaps=True, evict_reads=True,
+            stall_escalation=True)
         polished = p.run(not self.include_unpolished)
         return [(s.name, s.data) for s in polished], dict(p.timings)
 
@@ -418,8 +841,8 @@ class ShardRunner:
 
     def _extract_shard(self, si: int, shard: List[int]) -> Dict[str, str]:
         """Write this shard's input triple from the original files by
-        byte range (deterministic, so a retried/resumed shard sees the
-        identical inputs)."""
+        byte range (deterministic, so a retried/resumed/reclaimed shard
+        sees the identical inputs)."""
         d = os.path.join(self.work_dir, f"shard_{si:04d}")
         os.makedirs(d, exist_ok=True)
         idx = self.index

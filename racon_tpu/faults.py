@@ -1,0 +1,294 @@
+"""Fault taxonomy + deterministic fault injection (``RACON_TPU_FAULTS``).
+
+Two halves, both first-party:
+
+**Taxonomy** — every shard-attempt failure is classified into one of
+four classes (:func:`classify`), and the shard runner's degradation
+ladder picks the per-class policy from the class, never from the
+exception type alone:
+
+- ``transient-io`` — retryable I/O (EINTR/EAGAIN/EIO/ENOSPC/...):
+  exponential backoff with deterministic jitter, same engine;
+- ``device-oom`` — an XLA ``RESOURCE_EXHAUSTED`` (or any
+  out-of-memory text): memory backpressure — the consensus engine
+  halves its pair-arena/group capacity (``reduce_capacity``) and the
+  shard re-dispatches on the *device* before the CPU engines are even
+  considered;
+- ``stall`` — the queue watchdog's second-timeout escalation
+  (:class:`StallError`): the wedged attempt is abandoned and the shard
+  moves down the ladder instead of hanging the process forever;
+- ``deterministic-compute`` — everything else: one CPU-engine retry,
+  then quarantine (the round-9 policy, now the ladder's *last* rungs).
+
+**Injection** — seeded, site-addressed fault injection for the chaos
+tests (and for operators reproducing a production fault). The grammar::
+
+    RACON_TPU_FAULTS=site:kind[@N][*][%P],site:kind...
+
+- *site* — a named injection point (:data:`KNOWN_SITES`): the
+  consensus dispatch, the aligner fetch, the part-file write, the
+  manifest write, the worker itself (``worker.kill`` SIGKILLs the
+  process — the chaos soak's crash source), and ``exec.polish`` (the
+  per-shard polish entry the legacy hook targets);
+- *kind* — ``io`` (transient EIO), ``enospc`` (disk full), ``oom``
+  (RESOURCE_EXHAUSTED), ``err`` (deterministic compute fault),
+  ``stall`` (:class:`StallError`), ``kill`` (SIGKILL own process);
+- ``@N`` — arm on the Nth hit of the site (1-based, default 1);
+- ``*`` — keep firing on every hit from N on (default: fire once);
+- ``%P`` — instead of ``@N``, fire each hit with probability P, drawn
+  from a per-site RNG seeded by ``RACON_TPU_FAULTS_SEED`` (and the
+  site name), so a chaos run replays byte-for-byte.
+
+``RACON_TPU_EXEC_FAULT_SHARD`` (round 9) is folded in as a back-compat
+alias: ``'2'``/``'2*'`` behave exactly as before — a deterministic
+device-engine fault on shard 2's first/every attempt — now routed
+through this registry and counted in the same metrics.
+
+Dependency-light (flags + obs.metrics only — no jax, no numpy), so the
+manifest writer and the io layer can consult it without pulling in a
+backend.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import signal
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from . import flags
+from .obs import metrics
+
+# ---------------------------------------------------------------- taxonomy
+
+CLASS_TRANSIENT = "transient-io"
+CLASS_OOM = "device-oom"
+CLASS_STALL = "stall"
+CLASS_COMPUTE = "deterministic-compute"
+
+CLASSES = (CLASS_TRANSIENT, CLASS_OOM, CLASS_STALL, CLASS_COMPUTE)
+
+
+class InjectedFault(RuntimeError):
+    """A deterministic compute fault raised by the injection harness."""
+
+
+class DeviceOOMError(RuntimeError):
+    """Injected analog of an XLA RESOURCE_EXHAUSTED allocation failure
+    (real ones arrive as jaxlib errors and classify by message text)."""
+
+
+class StallError(RuntimeError):
+    """A stalled pipeline attempt, raised by the queue watchdog's
+    second-timeout escalation (``racon_tpu.sanitize.QueueWatchdog``) or
+    injected — classified ``stall`` so the shard runner's ladder moves
+    the shard along instead of the process hanging forever."""
+
+
+class TransientIOError(OSError):
+    """Injected retryable I/O fault (constructed with a transient
+    errno, so :func:`classify` sees it like the real thing)."""
+
+
+# errnos worth retrying with backoff: interrupted/contended/timed-out
+# I/O plus disk-full (space can be freed under a long run) and stale
+# NFS handles (shared-FS multi-worker runs)
+_TRANSIENT_ERRNOS = frozenset(
+    e for e in (errno.EINTR, errno.EAGAIN, errno.EBUSY, errno.EIO,
+                errno.ETIMEDOUT, errno.ENOSPC, errno.EDQUOT,
+                getattr(errno, "ESTALE", None)) if e is not None)
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory")
+
+
+def classify(exc: BaseException) -> str:
+    """Fault class of an arbitrary shard-attempt failure (one of
+    :data:`CLASSES`). Message text decides the OOM class because real
+    device allocation failures arrive as backend-specific exception
+    types whose one stable property is the RESOURCE_EXHAUSTED text."""
+    if isinstance(exc, StallError):
+        return CLASS_STALL
+    if isinstance(exc, DeviceOOMError):
+        return CLASS_OOM
+    if isinstance(exc, OSError):
+        return (CLASS_TRANSIENT if exc.errno in _TRANSIENT_ERRNOS
+                else CLASS_COMPUTE)
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _OOM_MARKERS):
+        return CLASS_OOM
+    return CLASS_COMPUTE
+
+
+# --------------------------------------------------------------- injection
+
+KNOWN_SITES = ("consensus.dispatch", "align.fetch", "part.write",
+               "manifest.write", "worker.kill", "exec.polish")
+
+_KINDS = ("io", "enospc", "oom", "err", "stall", "kill")
+
+LEGACY_MESSAGE = "injected device-engine fault (RACON_TPU_EXEC_FAULT_SHARD)"
+
+
+@dataclass
+class FaultSpec:
+    """One parsed ``site:kind[@N][*][%P]`` entry."""
+
+    site: str
+    kind: str
+    at: int = 1            # fire on the Nth hit (1-based)
+    every: bool = False    # keep firing from the Nth hit on
+    prob: Optional[float] = None  # seeded per-hit probability instead
+
+
+def parse_spec(raw: str) -> Dict[str, List[FaultSpec]]:
+    """Parse a ``RACON_TPU_FAULTS`` value; raises ``ValueError`` on an
+    unknown site/kind or malformed entry (an operator typo must fail
+    loudly, not silently inject nothing)."""
+    out: Dict[str, List[FaultSpec]] = {}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, rest = entry.partition(":")
+        if not sep:
+            raise ValueError(f"RACON_TPU_FAULTS entry {entry!r} has no "
+                             f"':' — expected site:kind[@N][*][%P]")
+        if site not in KNOWN_SITES:
+            raise ValueError(f"RACON_TPU_FAULTS site {site!r} unknown "
+                             f"(known: {', '.join(KNOWN_SITES)})")
+        every = rest.endswith("*")
+        if every:
+            rest = rest[:-1]
+        prob: Optional[float] = None
+        at = 1
+        if "%" in rest:
+            rest, _, p = rest.partition("%")
+            prob = float(p)
+            if not 0.0 < prob <= 1.0:
+                raise ValueError(f"RACON_TPU_FAULTS probability {p!r} "
+                                 f"outside (0, 1]")
+        if "@" in rest:
+            rest, _, n = rest.partition("@")
+            at = int(n)
+            if at < 1:
+                raise ValueError("RACON_TPU_FAULTS @N is 1-based")
+        if rest not in _KINDS:
+            raise ValueError(f"RACON_TPU_FAULTS kind {rest!r} unknown "
+                             f"(known: {', '.join(_KINDS)})")
+        out.setdefault(site, []).append(
+            FaultSpec(site, rest, at=at, every=every, prob=prob))
+    return out
+
+
+# module state: parse cache keyed on the raw env strings (tests
+# monkeypatch the flags mid-process; a changed value reparses and
+# resets the hit counters), per-site hit counts, consumed one-shots,
+# and the seeded per-site RNGs
+_lock = threading.Lock()
+_cache_key: Optional[tuple] = None
+_specs: Dict[str, List[FaultSpec]] = {}
+_legacy: Optional[tuple] = None   # (shard_id, every_attempt)
+_hits: Dict[str, int] = {}
+_fired: set = set()
+_rngs: Dict[str, random.Random] = {}
+
+
+def _refresh_locked(raw: str, legacy_raw: str) -> None:
+    global _cache_key, _specs, _legacy
+    key = (raw, legacy_raw, flags.get_int("RACON_TPU_FAULTS_SEED"))
+    if key == _cache_key:
+        return
+    _cache_key = key
+    _specs = parse_spec(raw) if raw else {}
+    _hits.clear()
+    _fired.clear()
+    _rngs.clear()
+    legacy_raw = legacy_raw.strip()
+    if legacy_raw:
+        if legacy_raw.endswith("*"):
+            _legacy = (int(legacy_raw[:-1]), True)
+        else:
+            _legacy = (int(legacy_raw), False)
+    else:
+        _legacy = None
+
+
+def reset() -> None:
+    """Drop the parsed spec, hit counters and RNG streams — the next
+    :func:`check` reparses from the environment. Worker startup calls
+    this implicitly via the parse-cache key; tests replaying a seeded
+    sequence call it explicitly."""
+    global _cache_key
+    with _lock:
+        _cache_key = None
+        _specs.clear()
+        _hits.clear()
+        _fired.clear()
+        _rngs.clear()
+
+
+def _rng_locked(site: str) -> random.Random:
+    rng = _rngs.get(site)
+    if rng is None:
+        seed = flags.get_int("RACON_TPU_FAULTS_SEED")
+        rng = _rngs[site] = random.Random(f"{seed}:{site}")
+    return rng
+
+
+def _fire(site: str, kind: str) -> None:
+    metrics.inc(f"faults.injected.{site}")
+    if kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if kind == "io":
+        raise TransientIOError(
+            errno.EIO, f"injected transient I/O fault at {site}")
+    if kind == "enospc":
+        raise TransientIOError(
+            errno.ENOSPC, f"injected ENOSPC at {site}")
+    if kind == "oom":
+        raise DeviceOOMError(
+            f"injected RESOURCE_EXHAUSTED: out of memory at {site}")
+    if kind == "stall":
+        raise StallError(f"injected stall at {site}")
+    raise InjectedFault(f"injected deterministic fault at {site}")
+
+
+def check(site: str, *, shard: Optional[int] = None,
+          attempt: int = 0) -> None:
+    """Injection point: called at every named site; raises (or SIGKILLs
+    the process) when the active spec triggers, else returns at the
+    cost of two env-dict lookups. ``shard``/``attempt`` feed the legacy
+    per-shard alias at the ``exec.polish`` site."""
+    raw = flags.get_str("RACON_TPU_FAULTS")
+    legacy_raw = flags.get_str("RACON_TPU_EXEC_FAULT_SHARD")
+    if not raw and not legacy_raw.strip():
+        return
+    kind = None
+    with _lock:
+        _refresh_locked(raw, legacy_raw)
+        if site == "exec.polish" and _legacy is not None and \
+                shard == _legacy[0] and (_legacy[1] or attempt == 0):
+            kind = "legacy"
+        else:
+            n = _hits[site] = _hits.get(site, 0) + 1
+            for i, spec in enumerate(_specs.get(site, ())):
+                if spec.prob is not None:
+                    if _rng_locked(site).random() < spec.prob:
+                        kind = spec.kind
+                        break
+                elif spec.every:
+                    if n >= spec.at:
+                        kind = spec.kind
+                        break
+                elif n == spec.at and (site, i) not in _fired:
+                    _fired.add((site, i))
+                    kind = spec.kind
+                    break
+    if kind == "legacy":
+        metrics.inc("faults.injected.exec.polish")
+        raise InjectedFault(LEGACY_MESSAGE)
+    if kind is not None:
+        _fire(site, kind)

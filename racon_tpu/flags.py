@@ -132,6 +132,36 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Test hook: sleep this many seconds before polishing every "
          "shard after the first (lets kill/resume tests land a SIGKILL "
          "mid-run deterministically)."),
+    # ------------------------------------------------- fault tolerance
+    Flag("RACON_TPU_FAULTS", "", "str",
+         "Seeded site-addressed fault injection: "
+         "'site:kind[@N][*][%P],...' — sites consensus.dispatch / "
+         "align.fetch / part.write / manifest.write / worker.kill / "
+         "exec.polish; kinds io, enospc, oom, err, stall, kill; @N "
+         "arms on the Nth hit, '*' keeps firing, %P fires with seeded "
+         "probability P (see racon_tpu/faults.py)."),
+    Flag("RACON_TPU_FAULTS_SEED", "0", "int",
+         "Seed for probabilistic (%P) fault-injection draws, so a "
+         "chaos run replays deterministically."),
+    Flag("RACON_TPU_WORKER", "", "str",
+         "Worker identity recorded in shard leases, manifest entries "
+         "and heartbeat lines (default: hostname:pid)."),
+    Flag("RACON_TPU_EXEC_LEASE_TTL_S", "30", "float",
+         "Shard lease time-to-live in seconds: a worker that stops "
+         "refreshing its lease mtime for longer than this is presumed "
+         "dead and another worker may break the lease and reclaim the "
+         "shard."),
+    Flag("RACON_TPU_EXEC_POLL_S", "1", "float",
+         "Idle wait between shard-claim passes when every remaining "
+         "shard is leased by another worker."),
+    Flag("RACON_TPU_EXEC_RETRIES", "3", "int",
+         "Degradation-ladder budget for transient-io faults: retries "
+         "with exponential backoff on the same engine tier before the "
+         "shard moves down the ladder."),
+    Flag("RACON_TPU_EXEC_BACKOFF_S", "0.5", "float",
+         "Base of the transient-fault exponential backoff (doubled "
+         "per retry, deterministic jitter added; see the ladder in "
+         "racon_tpu/exec/runner.py)."),
     # -------------------------------------------------------- tests, bench
     Flag("RACON_TPU_SLOW", "0", "bool",
          "Enable the slow (tier-2) test set."),

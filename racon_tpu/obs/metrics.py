@@ -106,7 +106,7 @@ def clear(prefix: Optional[str] = None) -> None:
 _RUN_PREFIXES = ("align.", "poa.", "consensus.", "queue.", "retrace.",
                  "retrace_total.", "swallowed.", "trace.", "parse.",
                  "overlap.", "transmute", "bp.", "build.", "stitch",
-                 "exec.")
+                 "exec.", "faults.", "lease.")
 
 
 def clear_run() -> None:

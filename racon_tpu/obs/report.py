@@ -25,7 +25,10 @@ from typing import Dict, List, Optional
 
 from . import metrics
 
-SCHEMA_VERSION = 1
+# v2 (round 12): the "faults" section (fault-class / injected-site /
+# lease-event counts) became required and shard rows grew the
+# degradation-ladder fields (worker, attempts, crc32, reclaimed)
+SCHEMA_VERSION = 2
 
 _NUM = (int, float)
 
@@ -42,6 +45,7 @@ _TOP = {
     "retrace": (dict, True),            # phase -> jit-compile delta
     "queue": (dict, True),              # bounded-queue health
     "swallowed": (dict, True),          # fault key -> occurrence count
+    "faults": (dict, True),             # fault class/site/lease counts
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
     "shards": (list, False),            # exec runs: one row per shard
@@ -56,6 +60,7 @@ _SHARD_ROW = {
     "id": (int, True),
     "status": (str, True),
     "engine": (str, False),
+    "worker": (str, False),             # lease owner that finished it
     "mbp": (_NUM, False),
     "wall_s": (_NUM, False),
     "extract_s": (_NUM, False),
@@ -63,6 +68,9 @@ _SHARD_ROW = {
     "retrace": (dict, False),
     "peak_rss_mb": (int, False),
     "reason": (str, False),
+    "attempts": (list, False),          # degradation-ladder record
+    "crc32": (int, False),              # part checksum (merge verifies)
+    "reclaimed": (int, False),          # stale-lease takeover count
 }
 
 
@@ -100,6 +108,15 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         "queue": metrics.queue_summary(),
         "swallowed": {k: int(v)
                       for k, v in metrics.group("swallowed.").items()},
+        # fault-tolerance visibility: per-class fault counts, injected-
+        # site counts and backpressure halvings (``faults.*``) plus the
+        # lease lifecycle (``lease.claimed/expired/reclaimed/lost``) —
+        # every ladder decision also sits per-attempt in its shard row
+        "faults": {
+            **{k: int(v) for k, v in metrics.group("faults.").items()},
+            **{f"lease.{k}": int(v)
+               for k, v in metrics.group("lease.").items()},
+        },
         "peak_rss_bytes": metrics.peak_rss_bytes(),
         "metrics": metrics.snapshot(),
     }
@@ -112,8 +129,9 @@ def shard_row(entry: dict) -> dict:
     """One report row from a manifest shard entry (schema-checked keys
     only — manifest internals like part paths stay out of the report)."""
     row = {"id": int(entry["id"]), "status": str(entry["status"])}
-    for key in ("engine", "mbp", "wall_s", "extract_s", "timings",
-                "retrace", "peak_rss_mb", "reason"):
+    for key in ("engine", "worker", "mbp", "wall_s", "extract_s",
+                "timings", "retrace", "peak_rss_mb", "reason",
+                "attempts", "crc32", "reclaimed"):
         if entry.get(key) is not None:
             row[key] = entry[key]
     return row
@@ -149,7 +167,8 @@ def validate_report(rep) -> List[str]:
         return errors
     if rep["kind"] not in ("cli", "exec"):
         errors.append(f"kind {rep['kind']!r} not in ('cli', 'exec')")
-    for key in ("phases", "dispatch_fetch", "retrace", "swallowed"):
+    for key in ("phases", "dispatch_fetch", "retrace", "swallowed",
+                "faults"):
         _check_numeric_dict(errors, rep[key], key)
     for key in _QUEUE_KEYS:
         if not isinstance(rep["queue"].get(key), _NUM):
@@ -179,6 +198,11 @@ def validate_report(rep) -> List[str]:
                     f"{type(row[key]).__name__}")
         for key in set(row) - set(_SHARD_ROW):
             errors.append(f"shards[{i}] unknown key {key!r}")
+        for j, att in enumerate(row.get("attempts") or []):
+            if not isinstance(att, dict) or "class" not in att \
+                    or "action" not in att:
+                errors.append(f"shards[{i}].attempts[{j}] is not a "
+                              f"ladder record (class/action)")
     return errors
 
 

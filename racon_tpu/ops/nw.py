@@ -446,7 +446,7 @@ def _ops_to_cigar(path: np.ndarray) -> str:
 
 
 from .pallas_nw import PallasDispatchMixin
-from .. import obs
+from .. import faults, obs
 from ..obs import metrics
 
 
@@ -863,6 +863,7 @@ class TpuAligner(PallasDispatchMixin):
     def _finish_chunk(self, launched, band, cigars, reject, bp_meta=None):
         """Span-wrapped :meth:`_finish_chunk_impl` — the fetch half of
         the dispatch-vs-fetch split (blocks on the device result)."""
+        faults.check("align.fetch")
         with obs.span("align.fetch", pairs=len(launched[0]), band=band):
             self._finish_chunk_impl(launched, band, cigars, reject,
                                     bp_meta)

@@ -83,7 +83,7 @@ from jax import lax
 
 from .nw import _nw_wavefront_kernel, _walk_ops_kernel
 from .pallas_nw import PallasDispatchMixin
-from .. import flags, obs, sanitize
+from .. import faults, flags, obs, sanitize
 from ..core.window import WindowType
 from ..obs import metrics
 
@@ -1033,13 +1033,11 @@ class _ConsensusStream:
             L = min(L * 2, max_dev_L)
         return L
 
-    @staticmethod
-    def _cap_pairs(L: int, band: int) -> int:
-        """Greedy-fill pair budget for a bucket: the fixed lane arena
-        divided by this bucket's lane width — short windows pack more
-        pairs per group, the whole point of ragged packing."""
-        return max(2048, min(ARENA_LANES // (L + band),
-                             4 * MAX_GROUP_PAIRS))
+    def _cap_pairs(self, L: int, band: int) -> int:
+        """Greedy-fill pair budget for a bucket (delegates to the
+        engine so the ragged path and the warm-up estimate share one
+        backpressure-aware formula)."""
+        return self.eng.cap_pairs_for(L, band)
 
     # ----------------------------------------------------------- dispatch
 
@@ -1052,7 +1050,7 @@ class _ConsensusStream:
             # run()-style usage sees the batch-global maximum exactly
             if not self.buffer:
                 return
-            if not final and self.buffered_pairs < MAX_GROUP_PAIRS:
+            if not final and self.buffered_pairs < eng.group_pairs_cap:
                 return
             max_bb = max(self.max_bb_live, self.band_hint)
             # the padded path's geometry from the same live maximum:
@@ -1279,6 +1277,13 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # outputs, guarded per geometry by swar.swar_fits and globally
         # by the swar_ok probe — the knob exists for A/B measurement
         self.use_swar = use_swar
+        # memory backpressure (round 12): the shard runner's
+        # degradation ladder halves the effective pair-arena/group
+        # capacity on a device RESOURCE_EXHAUSTED and re-dispatches —
+        # output bytes are invariant to grouping, only the per-launch
+        # working set shrinks. 1 = full capacity; doubled per
+        # reduce_capacity() call up to _MAX_CAPACITY_SCALE.
+        self.capacity_scale = 1
         # sanitizer: per-engine shadow sampler for the refine loop (the
         # first SWAR group of every run is always checked) — the
         # consensus-side analog of TpuAligner._shadow
@@ -1297,6 +1302,45 @@ class TpuPoaConsensus(PallasDispatchMixin):
                       "stage_b_windows": 0, "wavefront_steps": 0,
                       "lanes_occupied": 0, "lanes_total": 0,
                       "groups": 0, "group_windows": 0}
+
+    # the floor keeps groups large enough that per-group fixed costs
+    # (fetch round trips) stay amortized: 16x reduction is already a
+    # 94% working-set cut — past that the device is simply too small
+    _MAX_CAPACITY_SCALE = 16
+
+    @property
+    def group_pairs_cap(self) -> int:
+        """Pairs per device group under the current backpressure scale
+        (``MAX_GROUP_PAIRS`` at scale 1)."""
+        return max(2048, MAX_GROUP_PAIRS // self.capacity_scale)
+
+    @property
+    def arena_lanes_cap(self) -> int:
+        """Ragged lane-arena budget under the current backpressure
+        scale (``ARENA_LANES`` at scale 1)."""
+        return max(2048 * 1024, ARENA_LANES // self.capacity_scale)
+
+    def cap_pairs_for(self, L: int, band: int) -> int:
+        """Greedy-fill pair budget for one ragged bucket: the lane
+        arena (fixed, until OOM backpressure halves it) divided by the
+        bucket's lane width — short windows pack more pairs per group,
+        the whole point of ragged packing."""
+        return max(2048, min(self.arena_lanes_cap // (L + band),
+                             4 * self.group_pairs_cap))
+
+    def reduce_capacity(self) -> bool:
+        """Halve the pair-arena/group capacity (device-OOM
+        backpressure). Returns False once at the floor — the caller's
+        ladder then falls through to the CPU engines. Grouping never
+        changes output bytes (windows are independent; the vote
+        accumulation is exact at any batch size), so a reduced
+        re-dispatch is byte-identical, just smaller."""
+        if self.capacity_scale >= self._MAX_CAPACITY_SCALE:
+            return False
+        self.capacity_scale *= 2
+        metrics.set_gauge("consensus.capacity_scale", self.capacity_scale)
+        metrics.inc("faults.backpressure_halvings")
+        return True
 
     def pack_metrics(self) -> dict:
         """Derived occupancy view of :attr:`stats` (zeros before any
@@ -1402,7 +1446,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             from ..parallel import partition_balanced
             total_pairs = sum(w.n_layers for _, w in live)
             n_groups = max(self.num_batches,
-                           -(-total_pairs // MAX_GROUP_PAIRS))
+                           -(-total_pairs // self.group_pairs_cap))
             if n_groups == 1:
                 groups = [list(live)]
             else:
@@ -1444,7 +1488,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             nWp_max = 1
             while nWp_max < max_wins + 1:
                 nWp_max *= 2
-            group_bytes = ((2 * Lq + 24) * MAX_GROUP_PAIRS
+            group_bytes = ((2 * Lq + 24) * self.group_pairs_cap
                            + 16 * Lb * nWp_max)
             inflight_cap = max(self.num_batches,
                                MAX_INFLIGHT_BYTES // max(group_bytes, 1))
@@ -1554,9 +1598,9 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 L = min(L * 2, max_dev_L)
             Lq = L + band
             Lb = min(L + GROW, Lq)
-            cap = _ConsensusStream._cap_pairs(L, band)
+            cap = self.cap_pairs_for(L, band)
         else:
-            cap = MAX_GROUP_PAIRS
+            cap = self.group_pairs_cap
         est_layer_len = min(est_layer_len or window_length + 64, Lq)
         max_nm = est_layer_len + min(est_layer_len + 64, Lb)
         steps, Lq2 = self._sweep_geometry(Lq, max_nm, est_layer_len)
@@ -1626,7 +1670,11 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
     def _rounds(self, launch, Lq, Lb, steps, Lq2=0) -> None:
         """Span-wrapped :meth:`_rounds_impl` — the async kernel dispatch
-        of a group's whole refinement loop."""
+        of a group's whole refinement loop (and the ``consensus.dispatch``
+        fault-injection site: a real device OOM surfaces here as a
+        RESOURCE_EXHAUSTED, which is exactly what the injected one
+        mimics)."""
+        faults.check("consensus.dispatch")
         with obs.span("poa.dispatch", pairs=launch["B"]):
             self._rounds_impl(launch, Lq, Lb, steps, Lq2)
 
@@ -1946,7 +1994,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         overrides = {i: st for i, _, st in survivors}
         self.stats["stage_b_windows"] += len(live)
         total_pairs = sum(w.n_layers for _, w in live)
-        n_groups = max(1, -(-total_pairs // MAX_GROUP_PAIRS))
+        n_groups = max(1, -(-total_pairs // self.group_pairs_cap))
         if n_groups == 1:
             groups = [live]
         else:

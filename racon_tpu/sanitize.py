@@ -267,18 +267,32 @@ class QueueWatchdog:
     """Stall monitor for a bounded producer/consumer queue: call
     :meth:`beat` on every put/get; if no beat lands for ``timeout``
     seconds the watchdog dumps all thread stacks (once per stall) and
-    counts the firing. Passive — it reports, it never kills the run."""
+    counts the firing.
+
+    **Escalation** (round 12): with an ``escalate_cb``, a stall that
+    persists past ``timeout * escalate_after`` fires the callback once
+    per stall — the pipelined polisher uses it to fail the attempt with
+    a ``stall``-class fault (:class:`racon_tpu.faults.StallError`) so
+    the shard runner's degradation ladder can retry/quarantine the
+    shard instead of the process hanging forever. Without a callback
+    the watchdog stays purely passive — it reports, it never kills the
+    run."""
 
     def __init__(self, timeout: float, name: str = "queue",
-                 stream=None):
+                 stream=None, escalate_cb=None,
+                 escalate_after: float = 2.0):
         self.timeout = float(timeout)
         self.name = name
         self.fired = 0
         self._stream = stream
+        self._escalate_cb = escalate_cb
+        self._escalate_after = max(1.0, float(escalate_after))
         self._last = time.monotonic()
         self._dumped_for_beat = -1.0
+        self._escalated_for_beat = -1.0
         self._stop = threading.Event()
         self.stalled = threading.Event()  # test hook: set on each dump
+        self.escalated = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def beat(self) -> None:
@@ -301,8 +315,8 @@ class QueueWatchdog:
         poll = max(0.01, self.timeout / 4.0)
         while not self._stop.wait(poll):
             last = self._last
-            if (time.monotonic() - last > self.timeout
-                    and self._dumped_for_beat != last):
+            idle = time.monotonic() - last
+            if idle > self.timeout and self._dumped_for_beat != last:
                 self._dumped_for_beat = last
                 self.fired += 1
                 warn(f"{self.name} stalled for > {self.timeout:.1f}s")
@@ -310,12 +324,28 @@ class QueueWatchdog:
                     f"{self.name} made no progress for "
                     f"{self.timeout:.1f}s", self._stream)
                 self.stalled.set()
+            if (self._escalate_cb is not None
+                    and idle > self.timeout * self._escalate_after
+                    and self._escalated_for_beat != last):
+                self._escalated_for_beat = last
+                metrics.inc("faults.stall_escalations")
+                warn(f"{self.name} still stalled after "
+                     f"{self.timeout * self._escalate_after:.1f}s — "
+                     f"escalating to a stall-class fault")
+                try:
+                    self._escalate_cb()
+                except Exception as e:
+                    warn(f"{self.name} stall-escalation callback "
+                         f"failed: {type(e).__name__}: {e}")
+                self.escalated.set()
 
 
-def queue_watchdog(name: str) -> Optional[QueueWatchdog]:
+def queue_watchdog(name: str,
+                   escalate_cb=None) -> Optional[QueueWatchdog]:
     """A started watchdog with the flag-configured timeout when the
     sanitizer is on, else None (callers guard beats with ``if wd:``)."""
     if not enabled():
         return None
     return QueueWatchdog(
-        flags.get_float("RACON_TPU_SANITIZE_WATCHDOG_S"), name).start()
+        flags.get_float("RACON_TPU_SANITIZE_WATCHDOG_S"), name,
+        escalate_cb=escalate_cb).start()

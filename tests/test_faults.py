@@ -1,0 +1,639 @@
+"""Fault-tolerant multi-worker shard execution (round 12).
+
+The concluding contracts under test:
+
+- **leases** — O_EXCL claim exclusion, mtime-TTL expiry, race-safe
+  break-and-reclaim, dead-pid fast reclaim;
+- **degradation ladder** — per-fault-class transitions (transient-io
+  backoff on the same engine, device-OOM arena backpressure with a
+  byte-identical device re-dispatch, stall -> CPU, deterministic ->
+  CPU -> quarantine), each attempt recorded in the manifest and the
+  run report's ``faults`` section;
+- **injection harness** — the ``RACON_TPU_FAULTS`` grammar, one-shot /
+  persistent / seeded-probability triggers, the legacy
+  ``RACON_TPU_EXEC_FAULT_SHARD`` alias routed through the registry;
+- **part durability** — size+CRC32 verification before merge, with a
+  corrupted part re-queued and re-polished instead of merged;
+- **chaos soak** — workers racing one manifest under SIGKILLs and
+  injected faults still merge output byte-identical to a single-shot
+  run (the acceptance criterion).
+"""
+
+import io
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from test_columnar_init import write_synthetic_assembly
+
+from racon_tpu import faults
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.exec import ShardRunner, lease, load_manifest
+from racon_tpu.exec import manifest as mf
+from racon_tpu.obs import metrics, report as obs_report
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def single_shot(rp, pp, lp, drop_unpolished=True, type_=PolisherType.C):
+    p = create_polisher(str(rp), str(pp), str(lp), type_, num_threads=4)
+    return b"".join(b">" + s.name + b"\n" + s.data + b"\n"
+                    for s in p.run(drop_unpolished))
+
+
+def sharded(rp, pp, lp, work_dir, **kw):
+    kw.setdefault("num_threads", 4)
+    runner = ShardRunner(str(rp), str(pp), str(lp), work_dir=str(work_dir),
+                         **kw)
+    buf = io.BytesIO()
+    summary = runner.run(buf)
+    return buf.getvalue(), summary, runner
+
+
+@pytest.fixture()
+def assembly(tmp_path):
+    return write_synthetic_assembly(tmp_path, seed=7, n_contigs=4,
+                                    contig=2500)
+
+
+# ---------------------------------------------------------------- taxonomy
+
+def test_classify():
+    import errno
+    assert faults.classify(OSError(errno.EIO, "x")) == \
+        faults.CLASS_TRANSIENT
+    assert faults.classify(OSError(errno.ENOSPC, "x")) == \
+        faults.CLASS_TRANSIENT
+    assert faults.classify(FileNotFoundError(2, "gone")) == \
+        faults.CLASS_COMPUTE
+    assert faults.classify(RuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory while trying to allocate")) \
+        == faults.CLASS_OOM
+    assert faults.classify(faults.DeviceOOMError("boom")) == \
+        faults.CLASS_OOM
+    assert faults.classify(faults.StallError("wedged")) == \
+        faults.CLASS_STALL
+    assert faults.classify(ValueError("bad input")) == \
+        faults.CLASS_COMPUTE
+
+
+def test_parse_spec_grammar():
+    spec = faults.parse_spec(
+        "align.fetch:io@3,consensus.dispatch:oom*,part.write:enospc,"
+        "worker.kill:kill@2,manifest.write:io%0.5")
+    assert spec["align.fetch"][0].at == 3
+    assert not spec["align.fetch"][0].every
+    assert spec["consensus.dispatch"][0].every
+    assert spec["part.write"][0].kind == "enospc"
+    assert spec["worker.kill"][0].kind == "kill"
+    assert spec["manifest.write"][0].prob == 0.5
+    with pytest.raises(ValueError, match="unknown"):
+        faults.parse_spec("nosuch.site:io")
+    with pytest.raises(ValueError, match="unknown"):
+        faults.parse_spec("align.fetch:frobnicate")
+    with pytest.raises(ValueError, match="1-based"):
+        faults.parse_spec("align.fetch:io@0")
+    with pytest.raises(ValueError, match="probability"):
+        faults.parse_spec("align.fetch:io%1.5")
+
+
+def test_injection_one_shot_and_persistent(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FAULTS", "align.fetch:io@2")
+    faults.check("align.fetch")                      # hit 1: armed at 2
+    with pytest.raises(faults.TransientIOError):
+        faults.check("align.fetch")                  # hit 2 fires
+    faults.check("align.fetch")                      # one-shot: consumed
+    monkeypatch.setenv("RACON_TPU_FAULTS", "align.fetch:io@1*")
+    for _ in range(3):                               # persistent
+        with pytest.raises(faults.TransientIOError):
+            faults.check("align.fetch")
+
+
+def test_injection_seeded_probability(monkeypatch):
+    def draws(seed):
+        monkeypatch.setenv("RACON_TPU_FAULTS", "align.fetch:err%0.5")
+        monkeypatch.setenv("RACON_TPU_FAULTS_SEED", seed)
+        faults.reset()  # replay the seeded stream from its start
+        out = []
+        for _ in range(32):
+            try:
+                faults.check("align.fetch")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+    a = draws("11")
+    b = draws("11")
+    c = draws("99")
+    assert a == b          # same seed replays bit-for-bit
+    assert a != c          # a different seed draws differently
+    assert 0 < sum(a) < 32  # and it actually fires sometimes
+
+
+# ------------------------------------------------------------------ leases
+
+def test_lease_claim_exclusion_and_release(tmp_path):
+    wd = str(tmp_path)
+    a = lease.try_claim(wd, 0, "worker-a")
+    assert a is not None
+    assert lease.try_claim(wd, 0, "worker-b") is None  # double-claim
+    assert lease.read_lease(wd, 0)["worker"] == "worker-a"
+    b = lease.try_claim(wd, 1, "worker-b")    # another shard is free
+    assert b is not None
+    a.release()
+    b.release()
+    assert lease.try_claim(wd, 0, "worker-b") is not None
+
+
+def test_lease_expiry_and_reclaim(tmp_path):
+    wd = str(tmp_path)
+    metrics.clear("lease.")
+    a = lease.try_claim(wd, 0, "worker-a", ttl_s=0.2)
+    assert a is not None
+    a._keeper.stop()          # simulate a dead worker: no heartbeats
+    a._keeper = None
+    # make the lease look abandoned: owner pid "alive" (it is us), so
+    # only the TTL can expire it
+    time.sleep(0.35)
+    b = lease.try_claim(wd, 0, "worker-b", ttl_s=0.2)
+    assert b is not None      # broken + reclaimed
+    assert lease.read_lease(wd, 0)["worker"] == "worker-b"
+    assert metrics.counter("lease.expired") >= 1
+    b.release()
+
+
+def test_lease_heartbeat_blocks_expiry(tmp_path):
+    wd = str(tmp_path)
+    a = lease.try_claim(wd, 0, "worker-a", ttl_s=10.0)
+    assert a is not None
+    # keeper refreshes mtime; a 0.3s-TTL claimant must still lose
+    # because the mtime is fresh
+    time.sleep(0.2)
+    assert lease.try_claim(wd, 0, "worker-b", ttl_s=10.0) is None
+    a.release()
+
+
+def test_lease_dead_pid_fast_reclaim(tmp_path):
+    """A same-host lease whose owner pid is gone is broken immediately,
+    without waiting out the TTL (kill-then-resume latency)."""
+    wd = str(tmp_path)
+    a = lease.try_claim(wd, 0, "worker-a", ttl_s=3600.0)
+    assert a is not None
+    a._keeper.stop()
+    a._keeper = None
+    # rewrite the payload with a certainly-dead pid
+    blob = json.loads(open(a.path, "rb").read())
+    blob["pid"] = 2 ** 22 + 1  # beyond default pid_max
+    with open(a.path, "w") as f:
+        json.dump(blob, f)
+    b = lease.try_claim(wd, 0, "worker-b", ttl_s=3600.0)
+    assert b is not None
+    b.release()
+
+
+def test_lease_race_single_winner(tmp_path):
+    wd = str(tmp_path)
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def contend(k):
+        barrier.wait()
+        got = lease.try_claim(wd, 0, f"worker-{k}")
+        if got is not None:
+            wins.append(got)
+
+    threads = [threading.Thread(target=contend, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+    wins[0].release()
+
+
+# --------------------------------------------------------- ladder: classes
+
+def test_transient_fault_backoff_retries_same_engine(assembly, tmp_path,
+                                                     monkeypatch):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_FAULTS", "exec.polish:io@1")
+    monkeypatch.setenv("RACON_TPU_EXEC_BACKOFF_S", "0.02")
+    got, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=2)
+    assert got == want
+    faulted = [e for e in summary["shards"] if e.get("attempts")]
+    assert len(faulted) == 1
+    (att,) = faulted[0]["attempts"]
+    assert att["class"] == "transient-io"
+    assert att["action"] == "retry-backoff"
+    assert att["backoff_s"] > 0
+    assert faulted[0]["engine"] == "primary"  # never left the engine
+    assert summary["faults"]["transient-io"] == 1
+    assert summary["faults"]["injected.exec.polish"] == 1
+
+
+def test_enospc_part_write_retries(assembly, tmp_path, monkeypatch):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_FAULTS", "part.write:enospc@1")
+    monkeypatch.setenv("RACON_TPU_EXEC_BACKOFF_S", "0.02")
+    got, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=2)
+    assert got == want
+    faulted = [e for e in summary["shards"] if e.get("attempts")]
+    assert len(faulted) == 1
+    assert faulted[0]["attempts"][0]["class"] == "transient-io"
+    assert faulted[0]["status"] == "done"
+
+
+def test_stall_fault_degrades_to_cpu(assembly, tmp_path, monkeypatch):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_FAULTS", "exec.polish:stall@1")
+    got, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=2)
+    assert got == want
+    faulted = [e for e in summary["shards"] if e.get("attempts")]
+    assert len(faulted) == 1
+    (att,) = faulted[0]["attempts"]
+    assert att["class"] == "stall"
+    assert att["action"] == "cpu-retry"
+    assert faulted[0]["engine"] == "cpu-retry"
+
+
+def test_transient_budget_exhaustion_walks_the_whole_ladder(
+        assembly, tmp_path, monkeypatch):
+    """A persistent transient fault burns its backoff budget, falls to
+    the CPU tier, keeps faulting (the site fires on every hit) and ends
+    quarantined — with the full per-attempt record in the manifest."""
+    rp, pp, lp = assembly
+    monkeypatch.setenv("RACON_TPU_FAULTS", "exec.polish:io@1*")
+    monkeypatch.setenv("RACON_TPU_EXEC_RETRIES", "2")
+    monkeypatch.setenv("RACON_TPU_EXEC_BACKOFF_S", "0.01")
+    got, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=1,
+                              keep_work_dir=True)
+    assert summary["quarantined"] == [0]
+    entry = summary["shards"][0]
+    actions = [a["action"] for a in entry["attempts"]]
+    assert actions == ["retry-backoff", "retry-backoff", "cpu-retry",
+                       "quarantine"]
+    assert "cpu retry" in entry["reason"]
+    # the on-disk manifest carries the same ladder record
+    m = load_manifest(str(tmp_path / "w"))
+    assert [a["action"] for a in m["shards"][0]["attempts"]] == actions
+
+
+def test_oom_backpressure_redispatch_parity(assembly, tmp_path,
+                                            monkeypatch):
+    """Device-OOM ladder rung: the consensus engine halves its
+    arena/group capacity and the shard re-dispatches ON THE DEVICE,
+    byte-identical (grouping never changes output bytes); the CPU tier
+    is never reached."""
+    rp, pp, lp = assembly
+    # the parity oracle is the SAME device-engine config without any
+    # injected fault (device consensus differs from the native-CPU
+    # single-shot baseline by design; what backpressure must preserve
+    # is the device path's own bytes)
+    want, _, _ = sharded(rp, pp, lp, tmp_path / "clean", n_shards=2,
+                         aligner_backend="tpu", consensus_backend="tpu")
+    monkeypatch.setenv("RACON_TPU_FAULTS",
+                       "align.fetch:io@1,consensus.dispatch:oom@1")
+    monkeypatch.setenv("RACON_TPU_EXEC_BACKOFF_S", "0.02")
+    got, summary, runner = sharded(
+        rp, pp, lp, tmp_path / "w", n_shards=2,
+        aligner_backend="tpu", consensus_backend="tpu")
+    assert got == want
+    classes = {a["class"]: a["action"]
+               for e in summary["shards"]
+               for a in e.get("attempts", [])}
+    assert classes["transient-io"] == "retry-backoff"
+    assert classes["device-oom"] == "reduce-capacity"
+    assert all(e["engine"] == "primary" for e in summary["shards"])
+    consensus = runner._engines[1]
+    assert consensus.capacity_scale == 2           # halved once
+    assert consensus.group_pairs_cap * 2 <= 32768 * 2  # shrunk caps
+    assert summary["faults"]["backpressure_halvings"] == 1
+
+
+def test_oom_exhausted_backpressure_falls_to_cpu(assembly, tmp_path,
+                                                 monkeypatch):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_FAULTS", "exec.polish:oom@1*")
+    got, summary, runner = sharded(rp, pp, lp, tmp_path / "w",
+                                   n_shards=1)
+    # native primary engines expose no capacity knob: the oom rung is
+    # skipped and the ladder falls straight to the CPU tier, where the
+    # (every-attempt) injection keeps firing -> quarantine
+    assert summary["quarantined"] == [0]
+    actions = [a["action"] for a in summary["shards"][0]["attempts"]]
+    assert actions == ["cpu-retry", "quarantine"]
+
+
+def test_legacy_alias_routes_through_registry(assembly, tmp_path,
+                                              monkeypatch):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_EXEC_FAULT_SHARD", "1")
+    got, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=4)
+    assert got == want
+    entry = summary["shards"][1]
+    assert entry["engine"] == "cpu-retry"
+    assert "injected device-engine fault" in entry["reason"]
+    # the alias is counted by the one fault registry now
+    assert summary["faults"]["injected.exec.polish"] == 1
+    assert summary["faults"]["deterministic-compute"] == 1
+
+
+def test_manifest_write_transient_fault_survives(assembly, tmp_path,
+                                                 monkeypatch, capfd):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    monkeypatch.setenv("RACON_TPU_FAULTS", "manifest.write:io@2")
+    got, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=2)
+    assert got == want
+    assert not summary["quarantined"]
+    assert "retrying" in capfd.readouterr().err
+
+
+# ------------------------------------------------------ watchdog escalation
+
+def test_watchdog_escalation_fails_attempt_with_stall(tmp_path,
+                                                      monkeypatch):
+    """Satellite: after the stack-dump timeout, a second timeout fails
+    the attempt with a stall-class fault instead of hanging forever —
+    but only where the runner's ladder can catch it
+    (stall_escalation=True); standalone polishers keep the passive
+    dump-only watchdog (test_sanitize covers that half)."""
+    monkeypatch.setenv("RACON_TPU_SANITIZE", "1")
+    monkeypatch.setenv("RACON_TPU_SANITIZE_WATCHDOG_S", "0.2")
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=31, n_contigs=1,
+                                          contig=2000)
+    p = create_polisher(str(rp), str(pp), str(lp), num_threads=2,
+                        stall_escalation=True)
+
+    def wedged(overlaps, emit=None, chunk_windows=0):
+        time.sleep(8)  # producer wedged well past both timeouts
+
+    monkeypatch.setattr(p, "_assemble_layers", wedged)
+    t0 = time.monotonic()
+    with pytest.raises(faults.StallError):
+        p.run(True)
+    assert time.monotonic() - t0 < 5  # escalated, not 8s-wedged
+    assert faults.classify(faults.StallError("x")) == faults.CLASS_STALL
+    assert metrics.counter("faults.stall_escalations") >= 1
+
+
+# ------------------------------------------------------- part verification
+
+def test_corrupt_part_requeued_before_merge(assembly, tmp_path, capfd):
+    rp, pp, lp = assembly
+    want, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                               keep_work_dir=True)
+    # flip bytes inside a completed part (size preserved: only the CRC
+    # can catch it)
+    part = tmp_path / "w" / summary["shards"][1]["part"]
+    blob = bytearray(part.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    part.write_bytes(bytes(blob))
+    got, summary2, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                               resume=True, keep_work_dir=True)
+    assert got == want
+    err = capfd.readouterr().err
+    assert "failed verification" in err
+    assert "re-queueing" in err
+
+
+def test_truncated_part_requeued_before_merge(assembly, tmp_path, capfd):
+    rp, pp, lp = assembly
+    want, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                               keep_work_dir=True)
+    part = tmp_path / "w" / summary["shards"][2]["part"]
+    part.write_bytes(part.read_bytes()[:-40])
+    got, _, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=3,
+                        resume=True, keep_work_dir=True)
+    assert got == want
+    assert "failed verification" in capfd.readouterr().err
+
+
+# -------------------------------------------------------------- run report
+
+def test_run_report_faults_section(assembly, tmp_path, monkeypatch):
+    rp, pp, lp = assembly
+    monkeypatch.setenv("RACON_TPU_FAULTS", "exec.polish:io@1")
+    monkeypatch.setenv("RACON_TPU_EXEC_BACKOFF_S", "0.02")
+    _, summary, _ = sharded(rp, pp, lp, tmp_path / "w", n_shards=2,
+                            keep_work_dir=True)
+    with open(tmp_path / "w" / mf.REPORT_NAME, "rb") as f:
+        rep = json.loads(f.read())
+    assert obs_report.validate_report(rep) == []
+    assert rep["faults"]["transient-io"] == 1
+    assert rep["faults"]["injected.exec.polish"] == 1
+    assert rep["faults"]["lease.claimed"] >= 2
+    rows = {r["id"]: r for r in rep["shards"]}
+    faulted = [r for r in rows.values() if "attempts" in r]
+    assert len(faulted) == 1
+    assert faulted[0]["attempts"][0]["class"] == "transient-io"
+    assert all("crc32" in r and "worker" in r for r in rows.values())
+
+
+# ------------------------------------------------------------- multi-worker
+
+def _cli_env(**extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+def _cli_args(rp, pp, lp, wd, *more):
+    return [sys.executable, "-m", "racon_tpu", "-t", "2", "--shards", "4",
+            "--shard-dir", str(wd), *more, str(rp), str(pp), str(lp)]
+
+
+def test_workers_flag_spawns_cooperating_secondary(assembly, tmp_path):
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    proc = subprocess.run(
+        _cli_args(rp, pp, lp, tmp_path / "w", "--workers", "2"),
+        capture_output=True, timeout=600, cwd=REPO_ROOT, env=_cli_env())
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert proc.stdout == want
+
+
+def test_chaos_soak_kill_then_reclaim_byte_identical(assembly, tmp_path):
+    """The acceptance scenario: worker A is SIGKILLed mid-shard by the
+    injection harness (lease left heartbeat-less, shard state
+    ``running``); worker B — itself under an injected transient fault —
+    joins the same manifest, breaks the dead lease, reclaims the shard,
+    finishes the run and merges output byte-identical to a single-shot
+    run. Every decision is visible in the manifest and run report."""
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    wd = tmp_path / "w"
+
+    # worker A: dies on its second shard, after recording RUNNING
+    env_a = _cli_env(RACON_TPU_FAULTS="worker.kill:kill@2",
+                     RACON_TPU_WORKER="chaos-a",
+                     RACON_TPU_EXEC_LEASE_TTL_S="60")
+    proc_a = subprocess.run(_cli_args(rp, pp, lp, wd, "--resume"),
+                            capture_output=True, timeout=600,
+                            cwd=REPO_ROOT, env=env_a)
+    assert proc_a.returncode == -9  # SIGKILLed itself mid-shard
+    m = load_manifest(str(wd))
+    running = [e for e in m["shards"] if e["status"] == "running"]
+    assert len(running) == 1 and running[0]["worker"] == "chaos-a"
+    done_by_a = [e for e in m["shards"] if e["status"] == "done"]
+    assert len(done_by_a) == 1
+
+    # worker B: joins the manifest, reclaims the abandoned shard (fast
+    # path: the dead pid is detected without waiting out the TTL),
+    # survives its own injected transient fault, merges
+    env_b = _cli_env(RACON_TPU_FAULTS="exec.polish:io@1",
+                     RACON_TPU_WORKER="chaos-b",
+                     RACON_TPU_EXEC_LEASE_TTL_S="60",
+                     RACON_TPU_EXEC_BACKOFF_S="0.05")
+    proc_b = subprocess.run(_cli_args(rp, pp, lp, wd, "--resume"),
+                            capture_output=True, timeout=600,
+                            cwd=REPO_ROOT, env=env_b)
+    assert proc_b.returncode == 0, proc_b.stderr.decode()[-2000:]
+    assert proc_b.stdout == want                 # byte-identical merge
+    assert b"reclaiming shard" in proc_b.stderr
+
+    m = load_manifest(str(wd))
+    assert all(e["status"] == "done" for e in m["shards"])
+    workers = {e["worker"] for e in m["shards"]}
+    assert workers == {"chaos-a", "chaos-b"}
+    reclaimed = [e for e in m["shards"] if e.get("reclaimed")]
+    assert len(reclaimed) == 1                   # the abandoned shard
+    assert reclaimed[0]["worker"] == "chaos-b"
+    # the run report records the lease lifecycle and the ladder
+    with open(wd / mf.REPORT_NAME, "rb") as f:
+        rep = json.loads(f.read())
+    assert obs_report.validate_report(rep) == []
+    assert rep["faults"]["lease.reclaimed"] >= 1
+    assert rep["faults"]["injected.exec.polish"] == 1
+    assert rep["faults"]["transient-io"] == 1
+    assert any(r.get("attempts") for r in rep["shards"])
+
+
+def test_two_workers_racing_one_manifest(assembly, tmp_path):
+    """Two independently-launched workers start concurrently on an
+    empty work dir: exactly one publishes the plan (atomic
+    create-if-absent), both drain under lease exclusion, and both
+    merged outputs are byte-identical to the single-shot run."""
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    wd = tmp_path / "w"
+    env = {"RACON_TPU_EXEC_SLEEP_S": "0.5",
+           "RACON_TPU_EXEC_LEASE_TTL_S": "60"}
+    procs = [subprocess.Popen(
+        _cli_args(rp, pp, lp, wd, "--resume"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO_ROOT,
+        env=_cli_env(RACON_TPU_WORKER=f"race-{k}", **env))
+        for k in range(2)]
+    outs = []
+    for proc in procs:
+        out, err = proc.communicate(timeout=600)
+        assert proc.returncode == 0, err.decode()[-2000:]
+        outs.append(out)
+    assert outs[0] == want
+    assert outs[1] == want
+    m = load_manifest(str(wd))
+    assert all(e["status"] == "done" for e in m["shards"])
+
+
+# ------------------------------------------------- review-fix regressions
+
+def test_release_after_reclaim_preserves_new_lease(tmp_path):
+    """A worker whose lease was broken must not, on release, unlink the
+    reclaimer's lease at the same path (that would expose the shard to
+    double-claims)."""
+    wd = str(tmp_path)
+    a = lease.try_claim(wd, 0, "worker-a", ttl_s=0.1)
+    a._keeper.stop()
+    a._keeper = None
+    time.sleep(0.25)
+    b = lease.try_claim(wd, 0, "worker-b", ttl_s=0.1)
+    assert b is not None
+    a.release()  # late release by the presumed-dead owner
+    assert lease.read_lease(wd, 0)["worker"] == "worker-b"
+    assert a.lost.is_set()
+    b.release()
+    assert lease.read_lease(wd, 0) is None
+
+
+def test_corrupt_manifest_create_race_single_plan_wins(tmp_path):
+    """With a corrupt manifest on disk, racing workers must converge on
+    ONE published plan (each installing its own would cut parts by
+    different shard maps against one merge)."""
+    wd = str(tmp_path)
+    with open(os.path.join(wd, mf.MANIFEST_NAME), "wb") as f:
+        f.write(b'{"torn":')  # corrupt leftovers of a killed run
+    results = []
+    barrier = threading.Barrier(4)
+
+    def publish(k):
+        mine = {"fingerprint": {"k": "same"},
+                "shards": [{"id": 0, "contigs": [0], "status": "pending",
+                            "part": "part_0000.fasta",
+                            "planner": f"worker-{k}"}]}
+        barrier.wait()
+        results.append(mf.create_manifest_if_absent(wd, mine))
+
+    threads = [threading.Thread(target=publish, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    planners = {r["shards"][0]["planner"] for r in results}
+    assert len(planners) == 1        # every worker adopted one plan
+    on_disk = mf.load_manifest(wd)
+    assert on_disk["shards"][0]["planner"] in planners
+
+
+def test_stale_write_suppressed_after_lease_break(tmp_path, assembly,
+                                                  monkeypatch, capfd):
+    """A worker that finishes a shard AFTER its lease was broken must
+    not overwrite the reclaimer's state (its late quarantine would
+    silently drop a successfully polished shard from the merge)."""
+    rp, pp, lp = assembly
+    want = single_shot(rp, pp, lp)
+    got, summary, runner = sharded(rp, pp, lp, tmp_path / "w",
+                                   n_shards=2, keep_work_dir=True)
+    assert got == want
+    # simulate the split-brain tail: the old owner holds a broken lease
+    # and tries to record a late quarantine over the reclaimer's DONE
+    entry = dict(summary["shards"][0], status="quarantined",
+                 reason="late loser")
+    stale = lease.Lease(str(tmp_path / "w"), 0, "old-owner")
+    stale.lost.set()
+    manifest = load_manifest(str(tmp_path / "w"))
+    runner._save_owned(entry, manifest, stale)
+    m = load_manifest(str(tmp_path / "w"))
+    assert m["shards"][0]["status"] == "done"   # reclaimer's truth stands
+    assert entry["status"] == "done"            # loser adopted it
+    assert "discarding its late" in capfd.readouterr().err
+
+
+def test_fresh_run_refuses_to_clean_live_run_dir(tmp_path, assembly):
+    """A plain (non --resume) launch into a shard dir where another
+    worker holds a live lease must refuse instead of destroying the
+    running worker's checkpoints."""
+    rp, pp, lp = assembly
+    wd = tmp_path / "w"
+    os.makedirs(wd)
+    live = lease.try_claim(str(wd), 0, "other-worker")
+    assert live is not None
+    with pytest.raises(RuntimeError, match="live shard lease"):
+        sharded(rp, pp, lp, wd, n_shards=2)  # fresh run, same dir
+    live.release()
+    # with the lease gone the same fresh run proceeds normally
+    got, _, _ = sharded(rp, pp, lp, wd, n_shards=2)
+    assert got == single_shot(rp, pp, lp)

@@ -41,3 +41,20 @@ def test_bad_target_extension(paths):
     seqs, overlaps, _ = paths
     with pytest.raises(ValueError, match="unsupported format extension"):
         create_polisher(seqs, overlaps, "layout.txt")
+
+
+def test_malformed_overlap_file_names_file_and_line(tmp_path):
+    """End-to-end parser hardening: a torn overlap line deep in an
+    otherwise-valid file fails polisher initialization with a
+    structured error naming the file (and, on the Python oracle path,
+    the line) instead of a bare IndexError."""
+    lp = tmp_path / "t.fasta"
+    lp.write_bytes(b">A\n" + b"ACGT" * 100 + b"\n")
+    rp = tmp_path / "r.fasta"
+    rp.write_bytes(b">r1\n" + b"ACGT" * 90 + b"\n")
+    bad = tmp_path / "torn.paf"
+    bad.write_bytes(b"r1\t360\t0\t360\t+\tA\t400\t0\t360\t50\t100\t255\n"
+                    b"r1\t360\n")
+    p = create_polisher(str(rp), str(bad), str(lp))
+    with pytest.raises(ValueError, match=r"torn\.paf|malformed line"):
+        p.initialize()
