@@ -644,6 +644,91 @@ def bench_shards():
     return out
 
 
+def bench_multichip():
+    """Mbp/s-vs-chips scaling curve through the in-process chip
+    scheduler (ROADMAP item 2; the MULTICHIP_r06 artifact shape): polish
+    a RACON_TPU_BENCH_MULTICHIP-sized simulated assembly once per chip
+    count through the real CLI (``--chips k`` routes through the shard
+    runner's chip-worker pool), with a byte-identity assert of the
+    1-chip vs all-chip outputs. Each point runs in a subprocess — chip
+    visibility is process-level JAX state — sharing one persistent
+    compile cache so later points start warm. On a single-device host
+    point k provisions a k-virtual-device CPU mesh (capped at 4): the
+    schedule, leases and merge still execute end-to-end, but
+    wall-clock is NOT a hardware number (``multichip_devices`` records
+    which regime ran — only real-chip curves belong in a
+    BENCH/MULTICHIP record of merit). 0 disables."""
+    import os
+    import subprocess
+    import tempfile
+
+    from racon_tpu import flags as racon_flags
+
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_MULTICHIP")
+    if not mbp:
+        return {}
+    import jax
+
+    n_real = len(jax.local_devices())
+    fake = n_real == 1
+    # virtual mesh: cap at 4 chips — the point is exercising the
+    # scheduler end-to-end, and every fake chip pays a real per-device
+    # CPU compile for zero measurement value
+    n_chips = 4 if fake else n_real
+    points = sorted({1, 2, n_chips} - {0})
+    sim_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "simulate.py")
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        log(f"multichip bench: generating {mbp} Mbp workload...")
+        subprocess.run([sys.executable, sim_py, str(mbp), td,
+                        "--seed", "53"], check=True)
+        paths = [os.path.join(td, n)
+                 for n in ("reads.fastq", "ovl.paf", "draft.fasta")]
+        cache = os.path.join(td, "xla_cache")
+        curve = []
+        blobs = {}
+        for k in points:
+            env = dict(os.environ, RACON_TPU_COMPILE_CACHE=cache)
+            if fake:
+                # provision exactly k virtual devices per point: the
+                # 1-chip reference must BE one chip (no 8-way mesh),
+                # and point k must not idle 8-k fake devices' compiles
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={k}"
+                ).strip()
+            out_path = os.path.join(td, f"out_{k}.fasta")
+            log(f"multichip bench: --chips {k} "
+                + (f"({k} virtual CPU devices)..." if fake
+                   else "(hardware)..."))
+            t0 = time.perf_counter()
+            with open(out_path, "wb") as f:
+                subprocess.run(
+                    [sys.executable, "-m", "racon_tpu", "-t", "4",
+                     "-c", "1", "--tpualigner-batches", "1",
+                     "--chips", str(k)] + paths,
+                    stdout=f, check=True, env=env)
+            wall = time.perf_counter() - t0
+            with open(out_path, "rb") as f:
+                blobs[k] = f.read()
+            assert blobs[k].count(b">") > 0
+            curve.append({"chips": k, "wall_s": round(wall, 2),
+                          "mbp_per_sec": round(mbp / wall, 4)})
+            log(f"multichip bench: --chips {k}: {wall:.1f}s "
+                f"({mbp / wall:.4f} Mbp/s)")
+        assert blobs[points[0]] == blobs[points[-1]], \
+            "all-chip output diverged from the 1-chip output"
+        out.update(
+            multichip_mbp=mbp,
+            multichip_devices=(f"virtual-cpu-{n_chips}" if fake
+                               else f"hardware-{n_chips}"),
+            multichip_curve=curve,
+            multichip_identity="byte-identical")
+    return out
+
+
 def bench_parse():
     """Ingest throughput (VERDICT r3: parse must stay <10% of wall at
     >=100 Mbp inputs): ~100 MB of concatenated λ-phage FASTQ and ~100 MB
@@ -697,6 +782,7 @@ def main():
     scale_metrics = bench_scale()
     pipeline_metrics = bench_pipeline()
     shard_metrics = bench_shards()
+    multichip_metrics = bench_multichip()
     parse_metrics = bench_parse()
 
     total_bases = sum(len(w.sequences[0]) for w in windows)
@@ -715,6 +801,7 @@ def main():
         **scale_metrics,  # scale_mbp_per_sec + pack occupancy + A/B grid
         **pipeline_metrics,  # full-pipeline Mbp/s + CPU baseline
         **shard_metrics,  # streaming shard-runner scaling curve
+        **multichip_metrics,  # Mbp/s-vs-chips curve + identity assert
         **parse_metrics,
         "device": str(jax.devices()[0]),
     }

@@ -56,6 +56,16 @@ python -m pytest tests/test_exec.py -q
 python -m tools.analysis --quiet racon_tpu/faults.py racon_tpu/exec \
   racon_tpu/sanitize.py racon_tpu/io/parsers.py tests/test_faults.py
 python -m pytest tests/test_faults.py -q
+# multi-chip execution shard (fail-fast, round 13): graftlint gate over
+# the parallel package + exec runner, then the topology/planner/chip-
+# scheduler suite — get_mesh prefix selection, distributed_init
+# idempotence, device-aware planning (LPT over chips + mesh marking),
+# the 8-fake-device single-invocation byte-identity run with per-device
+# report rows, the persistent-compile-cache round trip and the ragged
+# stream-geometry warm-up — plus the existing mesh parity suite
+python -m tools.analysis --quiet racon_tpu/parallel racon_tpu/exec \
+  tests/test_topology.py
+python -m pytest tests/test_topology.py tests/test_parallel.py -q
 # observability shard (fail-fast, round 11): graftlint gate over the
 # obs package and every span-instrumented producer (span-discipline +
 # the 5 older rules), then the tracer/registry/report suite — trace
@@ -67,7 +77,8 @@ python -m pytest tests/test_obs.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
   --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
-  --ignore=tests/test_obs.py --ignore=tests/test_faults.py
+  --ignore=tests/test_obs.py --ignore=tests/test_faults.py \
+  --ignore=tests/test_topology.py --ignore=tests/test_parallel.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

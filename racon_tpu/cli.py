@@ -64,6 +64,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use banding approximation for alignment on the TPU")
     p.add_argument("--tpualigner-batches", type=int, default=0,
                    help="number of batches for TPU accelerated alignment")
+    p.add_argument("--chips", type=int, default=0, metavar="N",
+                   help="drive N local accelerator chips from this one "
+                        "process: the streaming shard runner spawns one "
+                        "in-process chip worker per device, each with "
+                        "its own pinned engines, all draining one shard "
+                        "manifest through the lease protocol (implies "
+                        "the shard runner; default: every local device "
+                        "when a device backend is in use on multi-chip "
+                        "hardware, 1 otherwise; RACON_TPU_CHIPS is the "
+                        "env equivalent)")
+    p.add_argument("--compile-cache", metavar="DIR", default=None,
+                   help="persistent XLA compilation cache directory: "
+                        "kernels compiled once are reloaded by every "
+                        "later run/process, so warm starts skip the "
+                        "tens-of-seconds cold compile "
+                        "(RACON_TPU_COMPILE_CACHE is the env "
+                        "equivalent; default ~/.cache/racon_tpu_xla, "
+                        "RACON_TPU_NO_COMPILE_CACHE=1 disables)")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="write a jax.profiler trace of the polishing run "
                         "to DIR (view with TensorBoard / xprof; the TPU "
@@ -223,7 +241,8 @@ def _run_sharded(args, argv, trace_path, report_path, t_start, t0) -> int:
             n_shards=args.shards,
             max_ram_bytes=parse_ram(args.max_ram) if args.max_ram else 0,
             resume=args.resume, work_dir=args.shard_dir,
-            secondary=secondary, defer_cleanup=workers > 1)
+            secondary=secondary, defer_cleanup=workers > 1,
+            chips=args.chips)
         if workers > 1 and not secondary:
             # the secondaries poll for the manifest this process is
             # about to publish, then start claiming shards; their
@@ -267,15 +286,31 @@ def _run_sharded(args, argv, trace_path, report_path, t_start, t0) -> int:
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    args = build_parser().parse_args(_preprocess_argv(list(argv)))
+    parser = build_parser()
+    args = parser.parse_args(_preprocess_argv(list(argv)))
+    if args.chips < 0:
+        parser.error(f"--chips must be >= 0 (got {args.chips}); "
+                     f"0 means automatic")
 
     trace_path, report_path = _obs_paths(args)
     obs.begin(trace_path, report_path)
     t_start = time.time()
     t0 = time.perf_counter()
 
+    if args.compile_cache:
+        # re-point the persistent XLA cache before anything compiles
+        # (the import-time default already armed it; an explicit DIR
+        # wins — the daemon-mode prerequisite for compile-free warm
+        # starts)
+        from . import ops
+        ops.configure_compile_cache(args.compile_cache)
+
+    # RACON_TPU_CHIPS is documented as the --chips env equivalent, so
+    # it must also route the run into the shard runner (where the chip
+    # scheduler lives) — not just tune it once something else did
     if args.shards or args.max_ram or args.resume or args.shard_dir \
-            or args.workers > 1 or args.exec_secondary:
+            or args.workers > 1 or args.exec_secondary or args.chips \
+            or flags.get_int("RACON_TPU_CHIPS") > 0:
         return _run_sharded(args, list(argv), trace_path, report_path,
                             t_start, t0)
 

@@ -141,7 +141,7 @@ def _auto_mesh(mesh):
 
 
 def make_aligner(backend: str, num_threads: int, num_batches: int = 1,
-                 mesh=None):
+                 mesh=None, device=None):
     if backend == "python":
         return PythonAligner()
     if backend in ("native", "cpu"):
@@ -151,9 +151,15 @@ def make_aligner(backend: str, num_threads: int, num_batches: int = 1,
             from ..ops.nw import TpuAligner
         except ImportError as e:
             raise ValueError(f"TPU aligner backend unavailable: {e}")
+        # an explicit chip pin is single-device by definition: the chip
+        # scheduler builds one engine per local device, so the
+        # every-visible-device auto-mesh must NOT engage under it
         return TpuAligner(fallback=NativeAligner(num_threads)
                           if native.available() else PythonAligner(),
-                          num_batches=num_batches, mesh=_auto_mesh(mesh))
+                          num_batches=num_batches,
+                          mesh=None if device is not None
+                          else _auto_mesh(mesh),
+                          device=device)
     if backend == "auto":
         if native.available():
             return NativeAligner(num_threads)
@@ -163,7 +169,7 @@ def make_aligner(backend: str, num_threads: int, num_batches: int = 1,
 
 def make_consensus(backend: str, match: int, mismatch: int, gap: int,
                    num_threads: int = 1, num_batches: int = 1,
-                   banded: bool = False, mesh=None):
+                   banded: bool = False, mesh=None, device=None):
     if backend == "python":
         return PythonPoaConsensus(match, mismatch, gap, num_threads)
     if backend in ("native", "cpu"):
@@ -176,11 +182,14 @@ def make_consensus(backend: str, match: int, mismatch: int, gap: int,
         except ImportError as e:
             raise ValueError(f"TPU consensus backend unavailable: {e}")
         # -b halves the alignment band (the reference's banded-cudapoa
-        # speed/accuracy trade, src/main.cpp:124-126)
+        # speed/accuracy trade, src/main.cpp:124-126); a chip pin
+        # (device) suppresses the auto-mesh — see make_aligner
         return TpuPoaConsensus(match, mismatch, gap,
                                fallback=CpuPoaConsensus(match, mismatch, gap,
                                                         num_threads),
                                band=BAND // 2 if banded else BAND,
                                num_batches=num_batches,
-                               mesh=_auto_mesh(mesh))
+                               mesh=None if device is not None
+                               else _auto_mesh(mesh),
+                               device=device)
     raise ValueError(f"unknown consensus backend {backend!r}")

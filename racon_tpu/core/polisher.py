@@ -297,7 +297,8 @@ class Polisher:
             # threshold: below ~16k pairs the whole polish costs less
             # than the compile the warm-up would race to hide
             if est_pairs >= 16384:
-                warm(self.window_length, est_pairs, est_windows)
+                warm(self.window_length, est_pairs, est_windows,
+                     est_contigs=self.targets_size)
 
         # transmute-parallelism (reference P3: one future per sequence,
         # ``polisher.cpp:368-377``): revcomp materialization is a numpy

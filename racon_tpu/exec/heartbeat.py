@@ -73,6 +73,11 @@ class Heartbeat:
         self._lock = threading.Lock()
         self._done = 0
         self._mbp = 0.0
+        # per-worker Mbp accumulators (round 13): concurrent in-process
+        # chip workers used to fold into ONE runner-side accumulator,
+        # which made any per-chip rate a fiction — the heartbeat now
+        # owns the split so per-chip Mbp/s is truthful
+        self._per: dict = {}
         self._phase = "indexing"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -102,6 +107,34 @@ class Heartbeat:
             if phase is not None:
                 self._phase = phase
 
+    def add_mbp(self, worker_key: Optional[str], mbp: float) -> None:
+        """Credit ``mbp`` polished megabases to ``worker_key`` (a chip
+        worker id, a remote worker's identity, ...). Negative deltas
+        (a re-queued shard's retraction) clamp at zero per key and in
+        the total."""
+        key = worker_key or "?"
+        with self._lock:
+            self._per[key] = max(0.0, self._per.get(key, 0.0) + mbp)
+            self._mbp = max(0.0, self._mbp + mbp)
+
+    @staticmethod
+    def _short(key: str) -> str:
+        """Display key: the chip suffix of an in-process worker id
+        (``host:123#chip2`` -> ``chip2``), the full id otherwise."""
+        return key.rsplit("#", 1)[-1]
+
+    def _per_worker_str(self, dt: float) -> str:
+        """``chip0=0.12,chip1=0.11`` Mbp/s rates when more than one
+        worker has contributed (empty otherwise — single-worker lines
+        stay exactly the round-12 format)."""
+        with self._lock:
+            per = dict(self._per)
+        if len(per) < 2:
+            return ""
+        rates = ",".join(f"{self._short(k)}={v / dt:.4f}"
+                         for k, v in sorted(per.items()))
+        return f" per[{rates} Mbp/s]"
+
     def emit(self, tag: str = "heartbeat") -> None:
         with self._lock:
             done, mbp, phase = self._done, self._mbp, self._phase
@@ -110,7 +143,8 @@ class Heartbeat:
         print(f"[racon_tpu::exec] {tag}{who}: "
               f"shard {done}/{self.n_shards} "
               f"({phase}) {mbp:.2f} Mbp in {dt:.1f}s "
-              f"({mbp / dt:.4f} Mbp/s) "
+              f"({mbp / dt:.4f} Mbp/s)"
+              f"{self._per_worker_str(dt)} "
               f"peak_rss={peak_rss_bytes() >> 20}MB "
               f"pack[{pack_summary_str()}] "
               f"queue[{queue_summary_str()}] "

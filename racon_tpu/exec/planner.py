@@ -23,6 +23,19 @@ grows the shard count until every bin fits), or a target-byte cap (the
 wrapper's ``--split`` semantics). A single contig whose cost exceeds the
 budget gets its own shard and a warning — splitting inside a contig
 would break window stitching.
+
+Device topology (round 13): with ``n_devices > 1`` the plan becomes
+chip-aware. A run with no sizing flags plans ``shards_per_chip x
+n_devices`` shards (k > 1 lets LPT rebalance stragglers); every plan
+then LPT-assigns its shards over the chips (:func:`assign_devices`,
+recorded per shard in the plan and manifest as an *advisory*
+preference — chip workers drain their own shards first and steal the
+rest through the lease protocol, so a slow chip never strands work). A
+single contig whose cost exceeds the balanced per-chip load by
+``MESH_DOMINANT_FACTOR`` would be the whole run's straggler on one
+chip; its shard is instead marked ``device = -1`` — mesh-sharded over
+ALL local chips via the existing ``sharded_align`` /
+``sharded_refine_loop`` path.
 """
 
 from __future__ import annotations
@@ -36,6 +49,14 @@ from ..utils.logger import warn
 from .index import RunIndex
 
 _MIN_AVAIL = 64 << 20  # floor for budget - base_rss before we warn
+# device-aware default: shards per chip (k x chips shards; k > 1 gives
+# LPT room to rebalance stragglers without starving any chip)
+SHARDS_PER_CHIP = 2
+# a lone contig whose cost exceeds the balanced per-chip load by this
+# factor runs mesh-sharded over all chips instead of pinning one chip
+# for longer than the rest of the whole run
+MESH_DOMINANT_FACTOR = 1.5
+MESH_DEVICE = -1  # ShardPlan.devices marker: mesh over all chips
 
 
 def parse_ram(text: str) -> int:
@@ -52,15 +73,25 @@ def parse_ram(text: str) -> int:
 class ShardPlan:
     shards: List[List[int]]               # contig indices, ascending
     costs: List[int]                      # recomputed per-bin cost
-    mode: str                             # "shards" | "max-ram" | "split"
+    mode: str                             # "shards"|"max-ram"|"split"|"chips"
     budget_bytes: int = 0                 # process budget (max-ram mode)
     avail_bytes: int = 0                  # budget - base_rss
     contig_cost: np.ndarray = field(
         default_factory=lambda: np.zeros(0, np.int64))
+    # advisory per-shard chip assignment (parallel to ``shards``):
+    # chip ordinal >= 0, or MESH_DEVICE (-1) = mesh over all chips.
+    # Process-local (each worker re-derives it for ITS devices after
+    # plan adoption); empty for single-chip plans.
+    devices: List[int] = field(default_factory=list)
 
     @property
     def n_shards(self) -> int:
         return len(self.shards)
+
+    def device_of(self, si: int) -> int:
+        """Advisory chip assignment of shard ``si`` (0 when the plan
+        carries none)."""
+        return self.devices[si] if si < len(self.devices) else 0
 
     def owner_of(self) -> dict:
         """contig index -> shard id."""
@@ -83,8 +114,41 @@ def _lpt(costs: np.ndarray, n_bins: int) -> List[List[int]]:
     return out
 
 
+def assign_devices(bins: List[List[int]], cost: np.ndarray,
+                   n_devices: int) -> List[int]:
+    """Advisory chip assignment for a shard list: mesh-mark dominant
+    single-contig shards, then LPT the rest over the chips.
+
+    Deterministic from (bins, cost, n_devices) so every in-process chip
+    worker — and a worker that ADOPTED the plan from the manifest —
+    derives the identical assignment for its own local topology."""
+    if n_devices <= 1 or not bins:
+        return []
+    shard_cost = np.asarray(
+        [sum(int(cost[ci]) for ci in b) for b in bins], np.int64)
+    per_chip = float(shard_cost.sum()) / n_devices
+    devices = [0] * len(bins)
+    rest: List[int] = []
+    for si, b in enumerate(bins):
+        if len(b) == 1 and shard_cost[si] > MESH_DOMINANT_FACTOR * per_chip:
+            # splitting inside a contig would break window stitching;
+            # mesh-shard its batches over every chip instead of letting
+            # one chip run it long after the others drained the rest
+            devices[si] = MESH_DEVICE
+        else:
+            rest.append(si)
+    loads = np.zeros(n_devices, np.int64)
+    for si in sorted(rest, key=lambda s: (-int(shard_cost[s]), s)):
+        d = int(np.argmin(loads))
+        devices[si] = d
+        loads[d] += int(shard_cost[si])
+    return devices
+
+
 def plan_shards(index: RunIndex, n_shards: int = 0, max_ram_bytes: int = 0,
-                max_target_bytes: int = 0, base_rss: int = 0) -> ShardPlan:
+                max_target_bytes: int = 0, base_rss: int = 0,
+                n_devices: int = 1,
+                shards_per_chip: int = SHARDS_PER_CHIP) -> ShardPlan:
     n_contigs = len(index.targets)
     t_bases = np.fromiter((t.bases for t in index.targets), np.int64,
                           n_contigs)
@@ -133,6 +197,13 @@ def plan_shards(index: RunIndex, n_shards: int = 0, max_ram_bytes: int = 0,
             n += 1
             bins = _lpt(t_bases, n)
         avail = budget = 0
+    elif n_devices > 1:
+        # no sizing flags, multiple chips: plan k x chips shards so one
+        # invocation saturates every local device (ROADMAP item 2)
+        mode = "chips"
+        n = max(1, min(max(1, shards_per_chip) * n_devices, n_contigs))
+        bins = _lpt(cost, n)
+        avail = budget = 0
     else:
         mode = "shards"
         bins = [list(range(n_contigs))]
@@ -142,4 +213,5 @@ def plan_shards(index: RunIndex, n_shards: int = 0, max_ram_bytes: int = 0,
         shards=bins,
         costs=[sum(int(cost[ci]) for ci in b) for b in bins],
         mode=mode, budget_bytes=budget, avail_bytes=avail,
-        contig_cost=cost)
+        contig_cost=cost,
+        devices=assign_devices(bins, cost, n_devices))

@@ -28,6 +28,22 @@ directory) drain one manifest together.
   CRC32; the pre-merge verification pass re-reads every part and
   re-queues a truncated/corrupt one instead of emitting a corrupt
   assembly.
+- **Chip scheduler** (round 13): one invocation drives every local
+  device. When a device backend is in use and the host has several
+  chips (or ``--chips N`` asks for them), the runner spawns one
+  in-process chip worker per device — each with its OWN
+  aligner/consensus pair pinned via ``jax.default_device`` (so every
+  chip runs the full single-device fast path: ragged packing,
+  streaming sessions, SWAR) — and the workers drain the SAME manifest
+  through the round-12 lease files, exactly like ``--workers``
+  subprocesses or shared-FS workers: no new coordination code, chips
+  and processes and hosts all interleave on one run. The plan carries
+  an advisory LPT chip assignment (each worker drains its own shards
+  first, then steals); a plan shard marked ``device = -1`` (one contig
+  dominating the run) is instead mesh-sharded over ALL chips by the
+  primary slot via the ``racon_tpu.parallel`` ``shard_map`` path.
+  Device-OOM backpressure (``reduce_capacity``) acts on the failing
+  worker's own engines — per *device*, not per process.
 
 Completed parts finally merge back into target-file order, which makes
 the output byte-identical to a single-shot run — the invariance proofs
@@ -42,6 +58,7 @@ import json
 import os
 import shutil
 import sys
+import threading
 import time
 import zlib
 from typing import Dict, List, Optional, Tuple
@@ -58,7 +75,8 @@ from . import heartbeat as hb
 from . import lease as lease_mod
 from . import manifest as mf
 from .index import RunIndex, build_index
-from .planner import ShardPlan, plan_shards
+from .planner import (MESH_DEVICE, ShardPlan, assign_devices,
+                      plan_shards)
 
 # verification/re-queue rounds before a persistently-corrupt part is a
 # hard error (each round re-polishes the shard from scratch)
@@ -85,6 +103,82 @@ def _terminal(entry: dict) -> bool:
     return entry.get("status") in (mf.DONE, mf.QUARANTINED)
 
 
+class _ChipWorker:
+    """One in-process executor slot of the chip scheduler: a worker
+    identity (suffixed ``#chipK`` so leases/manifest rows attribute
+    work per chip), an engine pair pinned to its local device, and —
+    for slot 0 only — the mesh engines that run dominant-contig shards
+    sharded over ALL chips. The legacy single-chip path is exactly one
+    unpinned slot whose worker id is the runner's own."""
+
+    def __init__(self, runner: "ShardRunner", slot, pinned: bool):
+        self.runner = runner
+        self.slot = slot                      # topology.ChipSlot
+        self.ordinal = slot.ordinal
+        self.device = slot.device if pinned else None
+        self.worker = (f"{runner.worker}#{slot.key}" if pinned
+                       else runner.worker)
+        self.can_mesh = slot.ordinal == 0
+        self.engines = None
+        self.cpu_engines = None
+        self.mesh_engines = None
+
+    def get_engines(self, cpu: bool, mesh: bool = False):
+        r = self.runner
+        if cpu:
+            if self.cpu_engines is None:
+                self.cpu_engines = (
+                    make_aligner("auto", r.num_threads),
+                    make_consensus("auto", r.match, r.mismatch, r.gap,
+                                   r.num_threads))
+            return self.cpu_engines
+        if mesh:
+            # dominant-contig shards: batches mesh-shard over every
+            # local chip via the parallel shard_map path (primary slot
+            # only — one mesh run at a time by lease exclusion)
+            if self.mesh_engines is None:
+                from ..parallel import get_mesh
+                # the RUN's chip set, not every visible device: a
+                # --chips 2 run on an 8-chip host must not trample the
+                # six excluded chips' HBM (nor inflate its own curve)
+                mesh_obj = get_mesh(devices=[
+                    w.device for w in r._chip_slots()])
+                self.mesh_engines = (
+                    make_aligner(r.aligner_backend, r.num_threads,
+                                 num_batches=r.aligner_batches,
+                                 mesh=mesh_obj),
+                    make_consensus(r.consensus_backend, r.match,
+                                   r.mismatch, r.gap, r.num_threads,
+                                   num_batches=r.consensus_batches,
+                                   banded=r.banded, mesh=mesh_obj))
+            return self.mesh_engines
+        if self.engines is None:
+            self.engines = (
+                make_aligner(r.aligner_backend, r.num_threads,
+                             num_batches=r.aligner_batches,
+                             device=self.device),
+                make_consensus(r.consensus_backend, r.match,
+                               r.mismatch, r.gap, r.num_threads,
+                               num_batches=r.consensus_batches,
+                               banded=r.banded, device=self.device))
+        return self.engines
+
+    def reduce_capacity(self, mesh: bool = False) -> bool:
+        """Memory backpressure for a device-oom fault, scoped to THIS
+        worker's engines — per device, not per process: chip 3 OOMing
+        must not shrink chip 0's arenas. False once the engines can
+        shrink no further (or expose no knob — CPU engines)."""
+        engines = self.mesh_engines if mesh else self.engines
+        if engines is None:
+            return False
+        reduced = False
+        for eng in engines:
+            shrink = getattr(eng, "reduce_capacity", None)
+            if shrink is not None and shrink():
+                reduced = True
+        return reduced
+
+
 class ShardRunner:
     """Bounded-memory, checkpointed, lease-coordinated drive of the
     polishing pipeline."""
@@ -102,7 +196,7 @@ class ShardRunner:
                  resume: bool = False, work_dir: Optional[str] = None,
                  keep_work_dir: Optional[bool] = None,
                  merge: bool = True, secondary: bool = False,
-                 defer_cleanup: bool = False):
+                 defer_cleanup: bool = False, chips: int = 0):
         self.sequences = os.path.abspath(sequences)
         self.overlaps = os.path.abspath(overlaps)
         self.target_sequences = os.path.abspath(target_sequences)
@@ -138,16 +232,34 @@ class ShardRunner:
         self.keep_work_dir = (keep_work_dir if keep_work_dir is not None
                               else (work_dir is not None or secondary))
         self.work_dir = os.path.abspath(work_dir or self.derive_work_dir())
+        # in-process chip workers (round 13): 0 = automatic — every
+        # local device when an accelerator backend is in use on real
+        # hardware (the virtual CPU test mesh never auto-engages; pass
+        # --chips/RACON_TPU_CHIPS to force it there); 1 pins the legacy
+        # single-chip path
+        self.chips_requested = chips
         self.index: Optional[RunIndex] = None
         self.plan: Optional[ShardPlan] = None
         self.summary: Dict = {}
         self.report: Dict = {}     # obs run report (also in work_dir)
-        self._engines = None       # (aligner, consensus) — reused per shard
-        self._cpu_engines = None   # lazy retry pair
+        self._slots: Optional[List[_ChipWorker]] = None
         self._retry_quarantined: set = set()  # resume: claimable again
         self._initially_done: set = set()     # resume-skip bookkeeping
         self._announced: set = set()
-        self._mbp_done = 0.0
+        self._beat = None          # heartbeat (owns Mbp attribution)
+        # shared-manifest discipline for concurrent chip workers: entry
+        # mutations and snapshot serialization must not interleave
+        self._mf_lock = threading.Lock()
+        self._note_lock = threading.Lock()
+        # chip-pool unwind: any worker thread dying sets this so the
+        # siblings stop polling (a dead primary's pending mesh shard
+        # would otherwise never turn terminal and the pool would hang)
+        self._abort = threading.Event()
+        # shared state-file scan (multi-slot runs): N idle workers
+        # re-reading the whole state directory every poll tick would
+        # multiply the shared-FS metadata I/O round 12 bounded
+        self._states_lock = threading.Lock()
+        self._states_cache: Tuple[float, Dict[int, dict]] = (-1e9, {})
 
     # ------------------------------------------------------------ identity
 
@@ -173,6 +285,77 @@ class ShardRunner:
                 "trim": self.trim, "match": self.match,
                 "mismatch": self.mismatch, "gap": self.gap,
                 "include_unpolished": self.include_unpolished}
+
+    # ---------------------------------------------------------- chip slots
+
+    def _chip_slots(self) -> List["_ChipWorker"]:
+        """This run's in-process executor slots (resolved once).
+
+        One unpinned slot — the exact legacy path — unless the chip
+        scheduler engages: an explicit request (``--chips`` /
+        ``RACON_TPU_CHIPS``) always wins; otherwise a device backend on
+        a real multi-chip host auto-engages every local device. The
+        virtual CPU test mesh (``xla_force_host_platform_device_count``)
+        never auto-engages — 8 fake devices on one CPU are a debugging
+        surface, not 8x compute — and a ``--workers`` run never
+        auto-engages on EITHER side (the spawned secondaries, or the
+        primary that spawned them — it shares the host's chips with
+        those secondaries already): the operator chose process-level
+        parallelism, so chips x workers on one host must be an explicit
+        choice."""
+        if self._slots is not None:
+            return self._slots
+        n = 1
+        explicit = self.chips_requested > 0 \
+            or flags.get_int("RACON_TPU_CHIPS") > 0
+        # defer_cleanup marks the primary of a --workers spawn (the CLI
+        # defers the work-dir cleanup past the secondaries' exit)
+        multi_process = self.secondary or self.defer_cleanup
+        if explicit:
+            from ..parallel import topology
+            n = topology.resolve_chips(self.chips_requested)
+        elif not multi_process and \
+                "tpu" in (self.aligner_backend, self.consensus_backend):
+            from ..parallel import topology
+            devs = topology.local_devices()
+            if len(devs) > 1 and \
+                    getattr(devs[0], "platform", "cpu") != "cpu":
+                n = len(devs)
+        if n <= 1:
+            from ..parallel.topology import ChipSlot
+            if explicit:
+                # an EXPLICIT --chips 1 means "use one chip": pin the
+                # first local device so the every-visible-device
+                # auto-mesh cannot engage — this is what makes the
+                # 1-chip point of a scaling curve actually one chip
+                from ..parallel import topology
+                devs = topology.local_devices()
+                self._slots = [_ChipWorker(
+                    self, ChipSlot(0, devs[0] if devs else None),
+                    pinned=bool(devs))]
+            else:
+                self._slots = [_ChipWorker(self, ChipSlot(0, None),
+                                           pinned=False)]
+        else:
+            from ..parallel import topology
+            topo = topology.Topology(n)
+            self._slots = [_ChipWorker(self, s, pinned=True)
+                           for s in topo.slots]
+            _eprint(f"chip scheduler: {len(self._slots)} in-process "
+                    f"chip workers ({topo.describe()['device_kind']})")
+        return self._slots
+
+    # back-compat internals (tests/bench poke the round-12 names): the
+    # primary slot's engine pairs
+    @property
+    def _engines(self):
+        slots = self._slots
+        return slots[0].engines if slots else None
+
+    @property
+    def _cpu_engines(self):
+        slots = self._slots
+        return slots[0].cpu_engines if slots else None
 
     # ----------------------------------------------------------------- run
 
@@ -204,7 +387,8 @@ class ShardRunner:
             self.plan = plan_shards(self.index, self.n_shards,
                                     self.max_ram_bytes,
                                     self.max_target_bytes,
-                                    base_rss=base_rss)
+                                    base_rss=base_rss,
+                                    n_devices=len(self._chip_slots()))
         os.makedirs(self.work_dir, exist_ok=True)
         # a valid resume/adopted manifest carries the stored plan (a
         # --max-ram plan depends on the planning process's live RSS, so
@@ -218,7 +402,7 @@ class ShardRunner:
         _eprint(f"plan: {len(self.index.targets)} contigs "
                 f"({total_mbp:.2f} Mbp), {len(self.index.ov_start)} "
                 f"overlaps -> {n} shards (mode={self.plan.mode})")
-        beat = hb.Heartbeat(n, worker=self.worker).start()
+        beat = self._beat = hb.Heartbeat(n, worker=self.worker).start()
         try:
             # only a worker that will MERGE verifies parts: it is the
             # emitted assembly the CRC pass protects, and N workers
@@ -261,6 +445,8 @@ class ShardRunner:
         self.summary = {
             "n_shards": n, "mode": self.plan.mode,
             "worker": self.worker,
+            "chips": len(self._chip_slots()),
+            "devices": metrics.device_summary(),
             "mbp_total": round(total_mbp, 4),
             "mbp_polished": round(mbp_done, 4),
             "wall_s": round(wall, 2),
@@ -325,9 +511,14 @@ class ShardRunner:
         if manifest is None:
             fresh = {
                 "fingerprint": fingerprint,
+                # "device" is the planner's ADVISORY chip assignment
+                # (-1 = mesh over all chips); workers adopting the plan
+                # re-derive it for their own local topology
                 "shards": [{"id": si, "contigs": list(map(int, shard)),
                             "status": mf.PENDING,
-                            "part": f"part_{si:04d}.fasta"}
+                            "part": f"part_{si:04d}.fasta",
+                            **({"device": self.plan.device_of(si)}
+                               if self.plan.devices else {})}
                            for si, shard in enumerate(self.plan.shards)],
             }
             # atomic create-if-absent: of N concurrently-starting
@@ -378,6 +569,11 @@ class ShardRunner:
         if sorted(ci for s in stored for ci in s) == \
                 list(range(len(self.index.targets))):
             self.plan.shards = stored
+            # the chip assignment is process-local (another worker's
+            # ordinals mean nothing here): re-derive it from the
+            # adopted shard map against THIS process's topology
+            self.plan.devices = assign_devices(
+                stored, self.plan.contig_cost, len(self._chip_slots()))
             return True
         warn("manifest shard plan does not cover this input's "
              "contigs — re-running every shard")
@@ -430,8 +626,9 @@ class ShardRunner:
         converge on their own transitions — re-reading every state file
         per write would be O(shards^2) metadata I/O on the shared
         filesystems multi-worker runs target."""
-        mf.save_shard_state(self.work_dir, entry)
-        mf.save_manifest(self.work_dir, manifest)
+        with self._mf_lock:
+            mf.save_shard_state(self.work_dir, entry)
+            mf.save_manifest(self.work_dir, manifest)
 
     def _save_owned(self, entry: dict, manifest: dict, claim) -> None:
         """Terminal-state write under lease-ownership proof: a worker
@@ -451,33 +648,128 @@ class ShardRunner:
             # does not carry the suppressed result forward
             fresh = mf.load_shard_state(self.work_dir, int(entry["id"]))
             if fresh is not None:
-                entry.clear()
-                entry.update(fresh)
+                with self._mf_lock:
+                    entry.clear()
+                    entry.update(fresh)
             return
         self._save(entry, manifest)
 
     # ---------------------------------------------------------- drain loop
 
     def _drain(self, manifest: dict, beat) -> None:
-        """Claim-and-run until every shard is terminal: each pass walks
-        the plan, claims what it can, and runs what it claims; when
-        every remaining shard is leased by another live worker, poll —
-        a lease whose worker died expires after the TTL and the next
-        pass reclaims the shard."""
+        """Drain the manifest with every executor slot: the single-slot
+        case runs the claim loop inline (the legacy path, byte for
+        byte); with the chip scheduler engaged, one thread per chip
+        worker runs the SAME loop — coordination is entirely the lease
+        files, so in-process chips, ``--workers`` subprocesses and
+        shared-FS workers interleave on one manifest with no extra
+        protocol."""
+        slots = self._chip_slots()
+        if len(slots) == 1:
+            self._drain_loop(slots[0], manifest, beat)
+            return
+        self._abort.clear()
+        errors: List[BaseException] = []
+
+        def body(worker: "_ChipWorker") -> None:
+            try:
+                self._drain_loop(worker, manifest, beat)
+            # graftlint: disable=swallowed-exception (re-raised below after the join)
+            except BaseException as e:
+                errors.append(e)
+                # unwind the pool: siblings must not keep polling for
+                # shards only the dead worker could run (a mesh shard
+                # of a dead primary never turns terminal)
+                self._abort.set()
+
+        threads = [threading.Thread(target=body, args=(w,),
+                                    name=f"racon-{w.slot.key}",
+                                    daemon=True)
+                   for w in slots[1:]]
+        for t in threads:
+            t.start()
+        body(slots[0])
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    def _shard_order(self, worker: "_ChipWorker") -> List[int]:
+        """The order a slot walks the plan: mesh-marked shards first
+        (primary slot only — they are the biggest by construction),
+        then the slot's own assigned shards, then everyone else's
+        (work stealing through the lease protocol keeps a fast chip
+        from idling behind a slow one's backlog)."""
         n = self.plan.n_shards
+        devs = self.plan.devices
+        if not devs:
+            return list(range(n))
+        mesh = [si for si in range(n) if devs[si] == MESH_DEVICE]
+        mine = [si for si in range(n) if devs[si] == worker.ordinal]
+        rest = [si for si in range(n)
+                if devs[si] != MESH_DEVICE and devs[si] != worker.ordinal]
+        return (mesh if worker.can_mesh else []) + mine + rest
+
+    def _drain_loop(self, worker: "_ChipWorker", manifest: dict,
+                    beat) -> None:
+        """Claim-and-run until every shard is terminal: each pass walks
+        the plan (own shards first), claims what it can, and runs what
+        it claims; when every remaining shard is leased by another live
+        worker, poll — a lease whose worker died expires after the TTL
+        and the next pass reclaims the shard."""
         poll_s = max(0.05, flags.get_float("RACON_TPU_EXEC_POLL_S"))
+        multi = len(self._chip_slots()) > 1
+        if multi:
+            # mirror this thread's span timers under device.<ordinal>.*
+            # so the run report gets per-chip dispatch/fetch seconds
+            obs.trace.set_timer_prefix(f"device.{worker.ordinal}.")
+        try:
+            self._drain_loop_inner(worker, manifest, beat, poll_s,
+                                   multi)
+        finally:
+            if multi:
+                obs.trace.set_timer_prefix(None)
+
+    def _load_states(self, max_age_s: float) -> Dict[int, dict]:
+        """State-file scan with a short shared cache: N concurrent chip
+        workers polling the same directory would otherwise multiply the
+        shared-FS metadata I/O N-fold for identical data. Staleness is
+        bounded and safe — states only move toward terminal, so a stale
+        snapshot can only delay (never fabricate) progress."""
+        now = time.monotonic()
+        with self._states_lock:
+            ts, states = self._states_cache
+            if now - ts <= max_age_s:
+                return states
+        states = mf.load_shard_states(self.work_dir)
+        with self._states_lock:
+            self._states_cache = (time.monotonic(), states)
+        return states
+
+    def _drain_loop_inner(self, worker: "_ChipWorker", manifest: dict,
+                          beat, poll_s: float, multi: bool) -> None:
+        order = self._shard_order(worker)
+        devs = self.plan.devices
+        cache_s = poll_s / 2 if multi else 0.0
         while True:
+            if self._abort.is_set():
+                return  # a sibling worker died; the pool is unwinding
             progressed = False
             waiting: List[int] = []
-            states = mf.load_shard_states(self.work_dir)
-            mf.merge_states(manifest, states)
-            for si, shard in enumerate(self.plan.shards):
+            states = self._load_states(cache_s)
+            with self._mf_lock:
+                mf.merge_states(manifest, states)
+            for si in order:
+                shard = self.plan.shards[si]
+                use_mesh = bool(devs) and devs[si] == MESH_DEVICE
+                if use_mesh and not worker.can_mesh:
+                    continue  # the primary slot owns mesh shards
                 entry = manifest["shards"][si]
                 if _terminal(entry) and si not in self._retry_quarantined:
                     self._note_terminal(si, entry, beat)
                     continue
                 claim = lease_mod.try_claim(self.work_dir, si,
-                                            self.worker)
+                                            worker.worker)
                 if claim is None:
                     waiting.append(si)
                     continue
@@ -486,7 +778,8 @@ class ShardRunner:
                     # have finished between our state read and the claim
                     fresh = mf.load_shard_state(self.work_dir, si)
                     if fresh is not None:
-                        manifest["shards"][si] = entry = dict(fresh)
+                        with self._mf_lock:
+                            manifest["shards"][si] = entry = dict(fresh)
                     if _terminal(entry) and \
                             si not in self._retry_quarantined:
                         self._note_terminal(si, entry, beat)
@@ -501,10 +794,21 @@ class ShardRunner:
                                 f"worker {entry.get('worker', '?')}")
                     beat.update(done=self._done_count(manifest),
                                 phase="polishing")
-                    with obs.track(f"shard {si}"), \
-                            obs.span("exec.shard", shard=si):
-                        self._run_shard(si, shard, entry, manifest,
-                                        beat, claim)
+                    if use_mesh and multi:
+                        # a mesh shard's dispatch/fetch seconds belong
+                        # to the report's "mesh" row, not to the chip
+                        # whose thread happens to drive it
+                        obs.trace.set_timer_prefix("device.mesh.")
+                    try:
+                        with obs.track(f"shard {si}"), \
+                                obs.span("exec.shard", shard=si):
+                            self._run_shard(si, shard, entry, manifest,
+                                            beat, claim, worker,
+                                            use_mesh)
+                    finally:
+                        if use_mesh and multi:
+                            obs.trace.set_timer_prefix(
+                                f"device.{worker.ordinal}.")
                 finally:
                     claim.release()
                 progressed = True
@@ -522,25 +826,37 @@ class ShardRunner:
         return sum(_terminal(e) for e in manifest["shards"])
 
     def _done_all(self, manifest: dict) -> bool:
-        mf.merge_states(manifest, mf.load_shard_states(self.work_dir))
-        return all(_terminal(e) for e in manifest["shards"])
+        # cached scan is sound here: states only move toward terminal,
+        # so a (bounded-stale) all-terminal snapshot was already true
+        states = self._load_states(
+            0.05 if len(self._chip_slots()) > 1 else 0.0)
+        with self._mf_lock:
+            mf.merge_states(manifest, states)
+            return all(_terminal(e) for e in manifest["shards"])
+
+    def _my_worker_ids(self) -> set:
+        return {w.worker for w in (self._slots or [])} | {self.worker}
 
     def _note_terminal(self, si: int, entry: dict, beat) -> None:
-        if si in self._announced or not _terminal(entry):
-            return
-        self._announced.add(si)
-        if entry["status"] == mf.DONE:
-            self._mbp_done += sum(self.index.targets[ci].bases
-                                  for ci in self.plan.shards[si]) / 1e6
+        with self._note_lock:
+            if si in self._announced or not _terminal(entry):
+                return
+            self._announced.add(si)
+            announced = len(self._announced)
         shard_mbp = sum(self.index.targets[ci].bases
                         for ci in self.plan.shards[si]) / 1e6
+        if entry["status"] == mf.DONE:
+            # per-worker attribution: the heartbeat owns the split so
+            # concurrent chip workers' Mbp/s rates stay truthful
+            beat.add_mbp(entry.get("worker"), shard_mbp)
         if si in self._initially_done and self.resume:
             _eprint(f"resume: skipping completed shard {si} "
                     f"({shard_mbp:.2f} Mbp)")
-        elif entry.get("worker") not in (None, self.worker):
+        elif entry.get("worker") not in (
+                {None} | self._my_worker_ids()):
             _eprint(f"shard {si} {entry['status']} by worker "
                     f"{entry.get('worker')}")
-        beat.update(done=len(self._announced), mbp=self._mbp_done)
+        beat.update(done=announced)
 
     # ------------------------------------------------- verification/requeue
 
@@ -597,50 +913,42 @@ class ShardRunner:
                      "requeued": why}
             manifest["shards"][si] = entry
             self._save(entry, manifest)
+            # a requeue moves a shard DONE -> PENDING, violating the
+            # states-only-move-toward-terminal assumption the bounded-
+            # staleness scan cache rests on: drop the cache so the next
+            # drain pass sees the PENDING state, not a stale all-DONE
+            # snapshot that would skip the re-polish
+            with self._states_lock:
+                self._states_cache = (-1e9, {})
+            shard_mbp = sum(self.index.targets[ci].bases
+                            for ci in self.plan.shards[si]) / 1e6
             if si in self._announced and was.get("status") == mf.DONE:
-                # keep the heartbeat honest: the re-run will re-add it
-                self._mbp_done = max(0.0, self._mbp_done - sum(
-                    self.index.targets[ci].bases
-                    for ci in self.plan.shards[si]) / 1e6)
+                if self._beat is not None:
+                    # keep the heartbeat honest: the re-run will re-add
+                    # it (retracted from the worker that claimed credit)
+                    self._beat.add_mbp(was.get("worker"), -shard_mbp)
+                if was.get("device") is not None and \
+                        len(self._chip_slots()) > 1 and \
+                        was.get("worker") in self._my_worker_ids():
+                    # retract the report's per-device shard/Mbp credit
+                    # too, or the re-run double-counts in the devices
+                    # rows — but only credit THIS process granted: a
+                    # resumed (or sibling-process) shard's counters
+                    # were never incremented here, and retracting them
+                    # would drive the devices rows negative
+                    # (polish_s deliberately stays cumulative —
+                    # it records real seconds spent, attempts included)
+                    dev_key = ("mesh" if was["device"] == MESH_DEVICE
+                               else str(was["device"]))
+                    metrics.inc(f"device.{dev_key}.shards", -1)
+                    metrics.inc(f"device.{dev_key}.mbp",
+                                -round(shard_mbp, 4))
             self._announced.discard(si)
             self._initially_done.discard(si)
         finally:
             claim.release()
 
     # ------------------------------------------------------ shard execution
-
-    def _get_engines(self, cpu: bool):
-        if cpu:
-            if self._cpu_engines is None:
-                self._cpu_engines = (
-                    make_aligner("auto", self.num_threads),
-                    make_consensus("auto", self.match, self.mismatch,
-                                   self.gap, self.num_threads))
-            return self._cpu_engines
-        if self._engines is None:
-            self._engines = (
-                make_aligner(self.aligner_backend, self.num_threads,
-                             num_batches=self.aligner_batches),
-                make_consensus(self.consensus_backend, self.match,
-                               self.mismatch, self.gap, self.num_threads,
-                               num_batches=self.consensus_batches,
-                               banded=self.banded))
-        return self._engines
-
-    def _reduce_capacity(self) -> bool:
-        """Memory backpressure for a device-oom fault: halve the
-        consensus engine's pair-arena/group capacity so the re-dispatch
-        allocates half the working set (output bytes are invariant to
-        grouping). False once the engines can shrink no further (or
-        expose no knob — CPU engines)."""
-        if self._engines is None:
-            return False
-        reduced = False
-        for eng in self._engines:
-            shrink = getattr(eng, "reduce_capacity", None)
-            if shrink is not None and shrink():
-                reduced = True
-        return reduced
 
     def _backoff_s(self, si: int, k: int) -> float:
         """Exponential backoff with deterministic jitter: base * 2^k,
@@ -652,17 +960,21 @@ class ShardRunner:
         return base * (2.0 ** k) * (0.75 + frac / 2000.0)
 
     def _run_shard(self, si: int, shard: List[int], entry: dict,
-                   manifest: dict, beat, claim) -> None:
+                   manifest: dict, beat, claim,
+                   worker: Optional["_ChipWorker"] = None,
+                   use_mesh: bool = False) -> None:
+        worker = worker if worker is not None else self._chip_slots()[0]
         sleep_s = flags.get_float("RACON_TPU_EXEC_SLEEP_S")
         if sleep_s > 0 and si > 0:
             time.sleep(sleep_s)  # test hook: widen the kill window
-        entry.update(status=mf.RUNNING, worker=self.worker)
-        # drop a previous incarnation's outcome fields (quarantine
-        # reason, attempt ladder, part stats) so the record describes
-        # THIS attempt's history only
-        for stale in ("requeued", "reason", "attempts", "engine",
-                      "bytes", "crc32"):
-            entry.pop(stale, None)
+        with self._mf_lock:
+            entry.update(status=mf.RUNNING, worker=worker.worker)
+            # drop a previous incarnation's outcome fields (quarantine
+            # reason, attempt ladder, part stats) so the record
+            # describes THIS attempt's history only
+            for stale in ("requeued", "reason", "attempts", "engine",
+                          "bytes", "crc32"):
+                entry.pop(stale, None)
         self._save(entry, manifest)
         # chaos-soak site: a SIGKILL here leaves the shard RUNNING with
         # a heartbeating-no-more lease — exactly the state another
@@ -670,7 +982,10 @@ class ShardRunner:
         faults.check("worker.kill")
         # per-shard attribution: the retrace gauges are process-wide, so
         # a shard that short-circuits (zero overlaps) must not inherit
-        # the previous shard's compile churn as its own telemetry
+        # the previous shard's compile churn as its own telemetry.
+        # (Concurrent chip workers share the process-wide gauges, so
+        # per-shard retrace rows are approximate under the scheduler —
+        # the retrace_total.* counters stay exact.)
         metrics.clear("retrace.")
         t0 = time.perf_counter()
 
@@ -691,8 +1006,9 @@ class ShardRunner:
                         paths = self._extract_shard(si, shard)
                     extract_s += time.perf_counter() - t_ext
                 faults.check("exec.polish", shard=si, attempt=attempt_no)
-                records, timings = self._polish_shard(paths,
-                                                      cpu=tier_cpu)
+                records, timings = self._polish_shard(
+                    paths, cpu=tier_cpu, worker=worker,
+                    use_mesh=use_mesh)
                 part_stat = self._write_part(part, records)
                 break
             except Exception as e:
@@ -717,11 +1033,12 @@ class ShardRunner:
                         paths = None  # re-extract after an I/O fault
                     time.sleep(backoff)
                 elif cls == faults.CLASS_OOM and not tier_cpu and \
-                        self._reduce_capacity():
+                        worker.reduce_capacity(mesh=use_mesh):
                     att["action"] = "reduce-capacity"
-                    warn(f"shard {si} device OOM ({err}) — halved the "
-                         f"consensus arena/group capacity, "
-                         f"re-dispatching on the device")
+                    warn(f"shard {si} device OOM ({err}) — halved "
+                         f"worker {worker.worker}'s consensus "
+                         f"arena/group capacity, re-dispatching on the "
+                         f"device")
                 elif not tier_cpu:
                     tier_cpu = True
                     att["action"] = "cpu-retry"
@@ -731,39 +1048,56 @@ class ShardRunner:
                     att["action"] = "quarantine"
                     warn(f"shard {si} CPU retry failed ({err}) — "
                          f"quarantining")
-                    entry.update(
-                        status=mf.QUARANTINED,
-                        reason=self._reason(attempts),
-                        attempts=attempts, worker=self.worker,
-                        wall_s=round(time.perf_counter() - t0, 2))
+                    with self._mf_lock:
+                        entry.update(
+                            status=mf.QUARANTINED,
+                            reason=self._reason(attempts),
+                            attempts=attempts, worker=worker.worker,
+                            wall_s=round(time.perf_counter() - t0, 2))
                     self._save_owned(entry, manifest, claim)
                     self._drop_shard_inputs(paths)
                     return
         else:  # unreachable backstop: the ladder ends in break/return
-            entry.update(status=mf.QUARANTINED,
-                         reason=self._reason(attempts),
-                         attempts=attempts, worker=self.worker,
-                         wall_s=round(time.perf_counter() - t0, 2))
+            with self._mf_lock:
+                entry.update(status=mf.QUARANTINED,
+                             reason=self._reason(attempts),
+                             attempts=attempts, worker=worker.worker,
+                             wall_s=round(time.perf_counter() - t0, 2))
             self._save_owned(entry, manifest, claim)
             self._drop_shard_inputs(paths)
             return
-        entry.update(
-            status=mf.DONE,
-            engine="cpu-retry" if tier_cpu else "primary",
-            worker=self.worker,
-            bytes=part_stat[0], crc32=part_stat[1],
-            mbp=round(sum(self.index.targets[ci].bases
-                          for ci in shard) / 1e6, 4),
-            wall_s=round(time.perf_counter() - t0, 2),
-            extract_s=round(extract_s, 2),
-            timings=timings,
-            retrace=metrics.group("retrace."),
-            peak_rss_mb=hb.peak_rss_bytes() >> 20)
-        if attempts:
-            # the per-attempt ladder record plus the round-9 summary
-            # string every fault-path test and operator greps for
-            entry["attempts"] = attempts
-            entry["reason"] = self._reason(attempts)
+        wall = round(time.perf_counter() - t0, 2)
+        shard_mbp = round(sum(self.index.targets[ci].bases
+                              for ci in shard) / 1e6, 4)
+        with self._mf_lock:
+            entry.update(
+                status=mf.DONE,
+                engine="cpu-retry" if tier_cpu else "primary",
+                worker=worker.worker,
+                bytes=part_stat[0], crc32=part_stat[1],
+                mbp=shard_mbp,
+                wall_s=wall,
+                extract_s=round(extract_s, 2),
+                timings=timings,
+                retrace=metrics.group("retrace."),
+                peak_rss_mb=hb.peak_rss_bytes() >> 20)
+            if self.plan.devices:
+                # the chip the shard actually ran on (-1 = mesh-sharded
+                # over all chips); lands in the manifest + report row
+                entry["device"] = (MESH_DEVICE if use_mesh
+                                   else worker.ordinal)
+            if attempts:
+                # the per-attempt ladder record plus the round-9 summary
+                # string every fault-path test and operator greps for
+                entry["attempts"] = attempts
+                entry["reason"] = self._reason(attempts)
+        if len(self._chip_slots()) > 1:
+            # per-chip telemetry: the report's "devices" rows and the
+            # heartbeat's per-chip Mbp/s read these registry counters
+            dev_key = "mesh" if use_mesh else str(worker.ordinal)
+            metrics.inc(f"device.{dev_key}.shards")
+            metrics.inc(f"device.{dev_key}.mbp", shard_mbp)
+            metrics.add_time(f"device.{dev_key}.polish_s", wall)
         self._save_owned(entry, manifest, claim)
         self._drop_shard_inputs(paths)
 
@@ -802,11 +1136,14 @@ class ShardRunner:
         mf.fsync_dir(self.work_dir)
         return size, crc
 
-    def _polish_shard(self, paths: Dict[str, str],
-                      cpu: bool) -> Tuple[List[Tuple[bytes, bytes]], Dict]:
+    def _polish_shard(self, paths: Dict[str, str], cpu: bool,
+                      worker: Optional["_ChipWorker"] = None,
+                      use_mesh: bool = False
+                      ) -> Tuple[List[Tuple[bytes, bytes]], Dict]:
         if paths["n_overlaps"] == 0:
             return self._unpolished_records(paths), {}
-        aligner, consensus = self._get_engines(cpu)
+        worker = worker if worker is not None else self._chip_slots()[0]
+        aligner, consensus = worker.get_engines(cpu, mesh=use_mesh)
         p = create_polisher(
             paths["reads"], paths["overlaps"], paths["targets"],
             self.type, window_length=self.window_length,

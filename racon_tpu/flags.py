@@ -132,6 +132,13 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Test hook: sleep this many seconds before polishing every "
          "shard after the first (lets kill/resume tests land a SIGKILL "
          "mid-run deterministically)."),
+    Flag("RACON_TPU_CHIPS", "", "int",
+         "In-process chip workers for the streaming shard runner "
+         "(equivalent to the CLI --chips flag): each local device gets "
+         "its own pinned engine pair draining manifest shards through "
+         "the lease protocol. Unset/0 = automatic (every local device "
+         "when a device backend is requested); 1 forces the legacy "
+         "single-chip path."),
     # ------------------------------------------------- fault tolerance
     Flag("RACON_TPU_FAULTS", "", "str",
          "Seeded site-addressed fault injection: "
@@ -180,6 +187,12 @@ REGISTRY: Dict[str, Flag] = _declare([
          "bench.py streaming shard-runner workload size in Mbp for the "
          "scaling-curve entry (includes a 4-shard-vs-single-shot "
          "bit-identity assert at a smaller scale; 0 disables)."),
+    Flag("RACON_TPU_BENCH_MULTICHIP", "2", "float",
+         "bench.py multi-chip scaling-curve workload size in Mbp "
+         "(Mbp/s vs chip count through the CLI chip scheduler, with a "
+         "1-chip-vs-all-chips byte-identity assert; on a single-device "
+         "host the points run on per-point virtual CPU meshes; 0 "
+         "disables)."),
 ])
 
 

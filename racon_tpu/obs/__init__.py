@@ -22,7 +22,8 @@ spans (:func:`jax_profile`).
 
 from __future__ import annotations
 
-from contextlib import nullcontext
+import threading
+from contextlib import contextmanager, nullcontext
 
 from . import metrics, report, trace
 from .trace import span, track  # noqa: F401  (the public span surface)
@@ -37,13 +38,34 @@ def begin(trace_path=None, report_path=None) -> None:
         trace.activate(tracing=bool(trace_path))
 
 
+# one jax.profiler session per process: concurrent chip workers each
+# bracket their consensus phase in jax_profile(), and a second
+# profiler.trace start raises mid-polish — the loser would fault its
+# shard down the degradation ladder over telemetry
+_profile_lock = threading.Lock()
+
+
 def jax_profile():
     """A context manager bracketing the enclosed phase in
     ``jax.profiler.trace(RACON_TPU_JAX_PROFILE)`` — a no-op nullcontext
-    when the flag is unset (jax is not even imported then)."""
+    when the flag is unset (jax is not even imported then).  JAX allows
+    ONE profiler session per process, so when another thread (a
+    concurrent chip worker) already holds it, the phase runs
+    unprofiled instead of aborting the shard."""
     from .. import flags
     profile_dir = flags.get_str("RACON_TPU_JAX_PROFILE")
     if not profile_dir:
         return nullcontext()
-    import jax
-    return jax.profiler.trace(profile_dir)
+    if not _profile_lock.acquire(blocking=False):
+        return nullcontext()
+
+    @contextmanager
+    def _held():
+        try:
+            import jax
+            with jax.profiler.trace(profile_dir):
+                yield
+        finally:
+            _profile_lock.release()
+
+    return _held()

@@ -106,7 +106,7 @@ def clear(prefix: Optional[str] = None) -> None:
 _RUN_PREFIXES = ("align.", "poa.", "consensus.", "queue.", "retrace.",
                  "retrace_total.", "swallowed.", "trace.", "parse.",
                  "overlap.", "transmute", "bp.", "build.", "stitch",
-                 "exec.", "faults.", "lease.")
+                 "exec.", "faults.", "lease.", "device.")
 
 
 def clear_run() -> None:
@@ -156,6 +156,23 @@ def queue_summary() -> Dict[str, Number]:
             "producer_wait_s": round(put_s, 3),
             "consumer_wait_s": round(get_s, 3),
             "stall_s": round(put_s + get_s, 3)}
+
+
+def device_summary() -> Dict[str, Dict[str, Number]]:
+    """Per-chip telemetry rows derived from the ``device.<ordinal>.*``
+    metrics the in-process chip workers publish: shard/Mbp counters,
+    polish seconds, and the per-thread span-timer mirrors
+    (``device.0.poa.dispatch`` -> row ``"0"``, key ``"poa.dispatch"``).
+    Empty for single-chip runs — the run report embeds this as its
+    ``devices`` section."""
+    rows: Dict[str, Dict[str, Number]] = {}
+    for k, v in group("device.").items():
+        dev, _, metric = k.partition(".")
+        if not dev or not metric:
+            continue
+        rows.setdefault(dev, {})[metric] = (
+            round(v, 6) if isinstance(v, float) else v)
+    return rows
 
 
 def peak_rss_bytes() -> int:

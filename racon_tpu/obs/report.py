@@ -27,8 +27,12 @@ from . import metrics
 
 # v2 (round 12): the "faults" section (fault-class / injected-site /
 # lease-event counts) became required and shard rows grew the
-# degradation-ladder fields (worker, attempts, crc32, reclaimed)
-SCHEMA_VERSION = 2
+# degradation-ladder fields (worker, attempts, crc32, reclaimed).
+# v3 (round 13): the "devices" section became required (per-chip
+# shard/Mbp/dispatch/fetch rows from the in-process chip scheduler;
+# empty object on single-chip runs) and shard rows grew "device" (the
+# chip ordinal a shard ran on; -1 = mesh-sharded over all chips)
+SCHEMA_VERSION = 3
 
 _NUM = (int, float)
 
@@ -46,6 +50,7 @@ _TOP = {
     "queue": (dict, True),              # bounded-queue health
     "swallowed": (dict, True),          # fault key -> occurrence count
     "faults": (dict, True),             # fault class/site/lease counts
+    "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
     "shards": (list, False),            # exec runs: one row per shard
@@ -71,6 +76,7 @@ _SHARD_ROW = {
     "attempts": (list, False),          # degradation-ladder record
     "crc32": (int, False),              # part checksum (merge verifies)
     "reclaimed": (int, False),          # stale-lease takeover count
+    "device": (int, False),             # chip ordinal (-1 = mesh shard)
 }
 
 
@@ -117,6 +123,11 @@ def build_report(kind: str, *, argv: Optional[list] = None,
             **{f"lease.{k}": int(v)
                for k, v in metrics.group("lease.").items()},
         },
+        # per-chip attribution (round 13): one row per local device the
+        # chip scheduler drove — shards/Mbp counters, polish seconds and
+        # the span-timer mirrors (dispatch/fetch per chip). {} on
+        # single-chip runs.
+        "devices": metrics.device_summary(),
         "peak_rss_bytes": metrics.peak_rss_bytes(),
         "metrics": metrics.snapshot(),
     }
@@ -131,7 +142,7 @@ def shard_row(entry: dict) -> dict:
     row = {"id": int(entry["id"]), "status": str(entry["status"])}
     for key in ("engine", "worker", "mbp", "wall_s", "extract_s",
                 "timings", "retrace", "peak_rss_mb", "reason",
-                "attempts", "crc32", "reclaimed"):
+                "attempts", "crc32", "reclaimed", "device"):
         if entry.get(key) is not None:
             row[key] = entry[key]
     return row
@@ -170,6 +181,11 @@ def validate_report(rep) -> List[str]:
     for key in ("phases", "dispatch_fetch", "retrace", "swallowed",
                 "faults"):
         _check_numeric_dict(errors, rep[key], key)
+    for dev, row in rep["devices"].items():
+        if not isinstance(dev, str) or not isinstance(row, dict):
+            errors.append(f"devices[{dev!r}] is not an object row")
+        else:
+            _check_numeric_dict(errors, row, f"devices[{dev!r}]")
     for key in _QUEUE_KEYS:
         if not isinstance(rep["queue"].get(key), _NUM):
             errors.append(f"queue[{key!r}] missing or non-numeric")

@@ -118,6 +118,12 @@ class _Span:
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter()
         metrics.add_time(self.name, t1 - self._t0)
+        # per-thread timer prefix (set_timer_prefix): the chip-worker
+        # threads mirror their spans under device.<ordinal>.* so the
+        # run report can attribute dispatch/fetch seconds per chip
+        pfx = getattr(_tls, "timer_prefix", None)
+        if pfx:
+            metrics.add_time(pfx + self.name, t1 - self._t0)
         if _tracing:
             b = _buf()
             b.append((b.tracks[-1] if b.tracks else None,
@@ -162,6 +168,14 @@ def track(name: str):
     if not _tracing:
         return NULL_SPAN
     return _Track(name)
+
+
+def set_timer_prefix(prefix) -> None:
+    """Mirror the CURRENT THREAD's span timers under ``prefix + name``
+    in addition to the plain span name (None clears). The in-process
+    chip workers set ``device.<ordinal>.`` so per-chip dispatch/fetch
+    seconds land in the registry without any span call site changing."""
+    _tls.timer_prefix = prefix or None
 
 
 # ------------------------------------------------------------- lifecycle

@@ -14,22 +14,45 @@
 """
 
 import os as _os
-
-import jax as _jax
+from typing import Optional as _Optional
 
 from .. import flags as _flags
 from ..utils.logger import log_swallowed as _log_swallowed
 
-# Persist XLA compilations across processes: the kernels are recompiled per
-# (bucket shape x batch size) and a CLI/test run pays tens of seconds of
-# compile time otherwise. Opt out with RACON_TPU_NO_COMPILE_CACHE=1.
-if not _flags.get_bool("RACON_TPU_NO_COMPILE_CACHE"):
-    _cache_dir = (_flags.get_str("RACON_TPU_COMPILE_CACHE")
-                  or _os.path.join(_os.path.expanduser("~"), ".cache",
-                                   "racon_tpu_xla"))
+
+def configure_compile_cache(cache_dir: _Optional[str] = None,
+                            min_compile_time_s: float = 0.5
+                            ) -> _Optional[str]:
+    """Point XLA's persistent compilation cache at ``cache_dir``.
+
+    The kernels are recompiled per (bucket shape x batch size) and a
+    cold CLI/test run pays tens of seconds of compile time otherwise —
+    for the resident-daemon direction (ROADMAP item 3) the cache IS the
+    difference between compile-dominated and compute-dominated jobs.
+    Resolution order: explicit argument (the CLI ``--compile-cache``),
+    ``RACON_TPU_COMPILE_CACHE``, ``~/.cache/racon_tpu_xla``.  Called
+    once at import with the flag defaults; calling again (any time
+    before the compiles it should capture) re-points the cache.
+    Returns the directory in effect, or None when setup failed — the
+    cache is an optimization, never fatal."""
+    cache_dir = (cache_dir
+                 or _flags.get_str("RACON_TPU_COMPILE_CACHE")
+                 or _os.path.join(_os.path.expanduser("~"), ".cache",
+                                  "racon_tpu_xla"))
     try:
-        _os.makedirs(_cache_dir, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        import jax as _jax
+
+        _os.makedirs(cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           min_compile_time_s)
+        return cache_dir
     except Exception as _e:  # cache is an optimization, never fatal
         _log_swallowed("ops: persistent XLA compile cache setup", _e)
+        return None
+
+
+# Persist XLA compilations across processes by default. Opt out with
+# RACON_TPU_NO_COMPILE_CACHE=1.
+if not _flags.get_bool("RACON_TPU_NO_COMPILE_CACHE"):
+    configure_compile_cache()

@@ -461,11 +461,16 @@ class TpuAligner(PallasDispatchMixin):
 
     def __init__(self, fallback=None, buckets=BUCKETS,
                  max_dirs_bytes=MAX_DIRS_BYTES, mesh=None,
-                 num_batches: int = 1, use_swar: bool = True):
+                 num_batches: int = 1, use_swar: bool = True,
+                 device=None):
         self.fallback = fallback
         self.buckets = buckets
         self.max_dirs_bytes = max_dirs_bytes
         self.mesh = mesh
+        # per-engine chip pin (mutually exclusive with a mesh): the
+        # in-process chip scheduler builds one aligner per local device
+        # and every launch/fetch runs under jax.default_device(device)
+        self.device = device
         # Batch count (reference --cudaaligner-batches N,
         # cudapolisher.cpp:91): the device pipeline depth. N chunks are
         # kept in flight (JAX async dispatch), each capped at 1/N of the
@@ -686,8 +691,8 @@ class TpuAligner(PallasDispatchMixin):
         """Span-wrapped :meth:`_launch_chunk_impl` — the dispatch half
         of the aligner's dispatch-vs-fetch split (host pack + async
         kernel dispatch; the device computes after this returns)."""
-        with obs.span("align.dispatch", pairs=len(chunk),
-                      max_len=max_len, band=band):
+        with self._pinned(), obs.span("align.dispatch", pairs=len(chunk),
+                                      max_len=max_len, band=band):
             return self._launch_chunk_impl(pairs, chunk, max_len, band,
                                            bp_meta)
 
@@ -864,7 +869,8 @@ class TpuAligner(PallasDispatchMixin):
         """Span-wrapped :meth:`_finish_chunk_impl` — the fetch half of
         the dispatch-vs-fetch split (blocks on the device result)."""
         faults.check("align.fetch")
-        with obs.span("align.fetch", pairs=len(launched[0]), band=band):
+        with self._pinned(), obs.span("align.fetch",
+                                      pairs=len(launched[0]), band=band):
             self._finish_chunk_impl(launched, band, cigars, reject,
                                     bp_meta)
 

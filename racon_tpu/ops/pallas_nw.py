@@ -771,7 +771,23 @@ def pallas_walk_ops(dirs, n, m, *, band: int):
 class PallasDispatchMixin:
     """Shared try-Pallas-then-XLA dispatch with a per-shape disable memo:
     one exotic-shape Mosaic failure must not downgrade the whole run to
-    the XLA kernels (the big well-tested shapes dominate wall-clock)."""
+    the XLA kernels (the big well-tested shapes dominate wall-clock).
+
+    Also hosts the per-engine device pin (``device`` ctor kwarg of both
+    engines): the in-process chip scheduler gives every local chip its
+    own engine pair, and :meth:`_pinned` is the thread-local
+    ``jax.default_device`` context the engines wrap their launch/fetch
+    halves in so host->device puts (and the computations that follow
+    them) land on that chip."""
+
+    device = None  # optional per-engine jax.Device pin
+
+    def _pinned(self):
+        if self.device is None:
+            import contextlib
+            return contextlib.nullcontext()
+        import jax
+        return jax.default_device(self.device)
 
     _pallas_failed_shapes = None
     # after this many distinct shape failures the breakage is systemic

@@ -1207,8 +1207,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
                  mesh=None, ins_theta: float = 0.25, del_beta: float = 0.65,
                  num_batches: int = 1, use_swar: bool = True,
                  use_matmul_votes: Optional[bool] = None,
-                 use_ragged: Optional[bool] = None):
+                 use_ragged: Optional[bool] = None, device=None):
         self.fallback = fallback
+        # per-engine chip pin (mutually exclusive with a mesh): the
+        # in-process chip scheduler builds one consensus engine per
+        # local device; pack/dispatch/fetch run under
+        # jax.default_device(device) so this engine's whole working set
+        # lives on its chip (PallasDispatchMixin._pinned)
+        self.device = device
         # int8/i32 MXU vote reduction (on by default; ctor arg or
         # RACON_TPU_MATMUL_VOTES=0 restores the f32-matmul + packed
         # scatter for A/B): exact integer accumulation, no fold cap —
@@ -1569,86 +1575,148 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
     # ------------------------------------------------------------- warm-up
 
+    @staticmethod
+    def _pow2_at_least(x: int) -> int:
+        p = 1
+        while p < max(1, x):
+            p *= 2
+        return p
+
+    def _warmup_shapes(self, window_length: int, est_pairs: int,
+                       est_windows: int, est_layer_len: int,
+                       est_contigs: int):
+        """The refinement-loop shapes a run is expected to dispatch, as
+        ``(Lq, Lb, band, steps, Lq2, B, nWp, rounds)`` tuples — ONE
+        source of truth consumed by :meth:`warmup_async`, derived with
+        the same geometry rules :meth:`run` / :class:`_ConsensusStream`
+        use."""
+        band, L, Lq, Lb = self._bucket_geometry(window_length)
+        depth = max(1.0, est_pairs / max(1, est_windows))
+        shapes = []
+
+        def add(L_b, pairs, wins, rounds):
+            lq = L_b + band
+            lb = min(L_b + GROW, lq)
+            ell = min(est_layer_len or window_length + 64, lq)
+            max_nm = ell + min(ell + 64, lb)
+            steps, Lq2 = self._sweep_geometry(lq, max_nm, ell)
+            shapes.append((lq, lb, band, steps, Lq2,
+                           self._pow2_at_least(pairs),
+                           self._pow2_at_least(wins + 1), rounds))
+
+        if not self.use_ragged:
+            cap = self.group_pairs_cap
+            n_groups = max(self.num_batches, -(-est_pairs // cap))
+            rounds = (min(self.rounds, STAGE_A_ROUNDS)
+                      if self.rounds > STAGE_A_ROUNDS and n_groups > 1
+                      else self.rounds)
+            add(L, -(-est_pairs // n_groups),
+                -(-est_windows // n_groups), rounds)
+            return shapes
+
+        # ragged stream geometry: windows bucket by their own
+        # power-of-two lane width and groups greedy-fill the arena, so
+        # the dominant bucket's FULL groups close just under
+        # cap_pairs_for(L) and pad to pow2(cap) — est_pairs/n_groups
+        # undershoots that shape whenever the estimate is not an exact
+        # multiple of the cap, wasting the warm compile precisely on
+        # big runs. A run smaller than one arena dispatches a single
+        # group of everything at the full round budget.
+        max_dev_L = (1 << 18) // (K_INS * CH) - GROW
+        Ld = 256
+        while Ld < max(256, min(window_length, max_dev_L)):
+            Ld = min(Ld * 2, max_dev_L)
+        cap = self.cap_pairs_for(Ld, band)
+        if est_pairs > cap:
+            wins = min(est_windows, max(1, int(cap / depth)),
+                       MAX_GROUP_WINDOWS)
+            # full groups dispatch with more work expected -> stage A
+            rounds = (min(self.rounds, STAGE_A_ROUNDS)
+                      if self.rounds > STAGE_A_ROUNDS else self.rounds)
+            add(Ld, cap, wins, rounds)
+        else:
+            add(Ld, est_pairs, min(est_windows, MAX_GROUP_WINDOWS),
+                self.rounds)
+        # contig-tail windows (<= one per contig, shorter than the
+        # window length) coalesce in the half-width bucket and flush as
+        # one lone full-budget group at finish
+        if est_contigs > 0 and Ld > 256 and est_pairs > cap:
+            # capped like any greedy-filled group: a fragmented assembly
+            # (10^5 contigs) must not warm a multi-GB batch the stream
+            # would never dispatch
+            t_pairs = min(max(1, int(est_contigs * depth)),
+                          self.cap_pairs_for(Ld // 2, band))
+            add(Ld // 2, t_pairs, min(est_contigs, MAX_GROUP_WINDOWS),
+                self.rounds)
+        return shapes
+
     def warmup_async(self, window_length: int, est_pairs: int,
-                     est_windows: int, est_layer_len: int = 0):
+                     est_windows: int, est_layer_len: int = 0,
+                     est_contigs: int = 0):
         """Background warm-up compilation of the expected refinement-loop
-        shape. The first consensus compile (~16 s) used to land inside
+        shapes. The first consensus compile (~16 s) used to land inside
         ``polish()``; ``Polisher.initialize`` calls this on a thread
         while it aligns overlaps, so ``polish()`` starts hot.
 
-        Derives the same static geometry :meth:`run` computes (band/L
-        from the window length, batch/window paddings from the pair and
-        window count estimates) and executes the jitted loop once on
-        zero state — ``win_real`` is all-false, so the device loop exits
-        before round 1 and the call costs exactly one compile (which the
-        persistent XLA cache then also remembers across runs). A wrong
-        estimate wastes a background compile and nothing else: run()'s
-        own shapes still compile on first use. Returns the thread (for
-        tests), or None when skipped (mesh runs, zero estimates)."""
+        Derives the same static geometry :meth:`run` /
+        :class:`_ConsensusStream` compute — for a ragged engine that is
+        the power-of-two *bucket* shapes the stream will actually
+        dispatch (the dominant bucket's greedy-filled full-group shape,
+        plus the half-width contig-tail bucket when ``est_contigs`` is
+        given), not the padded single geometry — and executes the jitted
+        loop once per shape on zero state: ``win_real`` is all-false, so
+        the device loop exits before round 1 and each shape costs
+        exactly one compile (which the persistent XLA cache then also
+        remembers across runs). Runs under the engine's pinned device
+        (:meth:`_pinned`), so per-chip engines warm their own chip. A
+        wrong estimate wastes a background compile and nothing else:
+        run()'s own shapes still compile on first use. Returns the
+        thread (for tests), or None when skipped (mesh runs, zero
+        estimates)."""
         if self.mesh is not None or est_pairs <= 0:
             return None
-        band, L, Lq, Lb = self._bucket_geometry(window_length)
-        if self.use_ragged:
-            # the ragged packer buckets by power-of-two lane widths and
-            # greedy-fills the lane arena — warm the dominant bucket's
-            # first-group shape
-            max_dev_L = (1 << 18) // (K_INS * CH) - GROW
-            L = 256
-            while L < max(256, min(window_length, max_dev_L)):
-                L = min(L * 2, max_dev_L)
-            Lq = L + band
-            Lb = min(L + GROW, Lq)
-            cap = self.cap_pairs_for(L, band)
-        else:
-            cap = self.group_pairs_cap
-        est_layer_len = min(est_layer_len or window_length + 64, Lq)
-        max_nm = est_layer_len + min(est_layer_len + 64, Lb)
-        steps, Lq2 = self._sweep_geometry(Lq, max_nm, est_layer_len)
-        n_groups = max(1 if self.use_ragged else self.num_batches,
-                       -(-est_pairs // cap))
-        B = 1
-        while B < max(1, -(-est_pairs // n_groups)):
-            B *= 2
-        nWp = 1
-        while nWp < max(1, -(-est_windows // n_groups)) + 1:
-            nWp *= 2
-        rounds = (min(self.rounds, STAGE_A_ROUNDS)
-                  if self.rounds > STAGE_A_ROUNDS and n_groups > 1
-                  else self.rounds)
+        shapes = self._warmup_shapes(window_length, est_pairs,
+                                     est_windows, est_layer_len,
+                                     est_contigs)
+
+        def _compile_one(Lq, Lb, band, steps, Lq2, B, nWp, rounds):
+            # the availability probes themselves compile and run
+            # kernels, so they belong on this thread too — the whole
+            # point is keeping the caller's critical path clear
+            from .swar import swar_fits, swar_ok
+            sw = self.use_swar and swar_fits(Lq) and swar_ok()
+            use_pallas = self._use_pallas((Lq, band, steps, Lb, Lq2))
+            if use_pallas:
+                from .pallas_nw import pallas_swar_ok
+                sw = sw and pallas_swar_ok()
+            static = (jnp.zeros((B,), jnp.int32),
+                      jnp.zeros((B, Lq), jnp.uint16),
+                      jnp.full((B,), nWp - 1, jnp.int32),
+                      jnp.zeros((B,), bool))
+            state = (jnp.zeros((B,), jnp.int32),
+                     jnp.zeros((B,), jnp.int32),
+                     jnp.zeros((nWp, Lb), jnp.uint8),
+                     jnp.zeros((nWp, Lb), jnp.float32),
+                     jnp.zeros((nWp,), jnp.int32),
+                     jnp.zeros((nWp, Lb), jnp.int32),
+                     jnp.zeros((nWp,), bool),
+                     jnp.zeros((nWp,), bool),
+                     jnp.zeros((nWp,), bool),
+                     jnp.zeros((1, 4), jnp.int32))
+            out = _refine_loop_packed(
+                *static, *state, jnp.float32(self.ins_theta),
+                jnp.float32(self.del_beta), rounds=rounds,
+                n_windows=nWp, max_len=Lq, band=band, Lb=Lb,
+                K=K_INS, steps=steps, use_pallas=use_pallas,
+                use_swar=sw, Lq2=Lq2, scores=self.scores,
+                matmul_votes=self.use_matmul_votes)
+            jax.block_until_ready(out[10])
 
         def _compile():
             try:
-                # the availability probes themselves compile and run
-                # kernels, so they belong on this thread too — the whole
-                # point is keeping the caller's critical path clear
-                from .swar import swar_fits, swar_ok
-                sw = self.use_swar and swar_fits(Lq) and swar_ok()
-                use_pallas = self._use_pallas((Lq, band, steps, Lb, Lq2))
-                if use_pallas:
-                    from .pallas_nw import pallas_swar_ok
-                    sw = sw and pallas_swar_ok()
-                static = (jnp.zeros((B,), jnp.int32),
-                          jnp.zeros((B, Lq), jnp.uint16),
-                          jnp.full((B,), nWp - 1, jnp.int32),
-                          jnp.zeros((B,), bool))
-                state = (jnp.zeros((B,), jnp.int32),
-                         jnp.zeros((B,), jnp.int32),
-                         jnp.zeros((nWp, Lb), jnp.uint8),
-                         jnp.zeros((nWp, Lb), jnp.float32),
-                         jnp.zeros((nWp,), jnp.int32),
-                         jnp.zeros((nWp, Lb), jnp.int32),
-                         jnp.zeros((nWp,), bool),
-                         jnp.zeros((nWp,), bool),
-                         jnp.zeros((nWp,), bool),
-                         jnp.zeros((1, 4), jnp.int32))
-                out = _refine_loop_packed(
-                    *static, *state, jnp.float32(self.ins_theta),
-                    jnp.float32(self.del_beta), rounds=rounds,
-                    n_windows=nWp, max_len=Lq, band=band, Lb=Lb,
-                    K=K_INS, steps=steps, use_pallas=use_pallas,
-                    use_swar=sw, Lq2=Lq2, scores=self.scores,
-                    matmul_votes=self.use_matmul_votes)
-                jax.block_until_ready(out[10])
+                with self._pinned():
+                    for shape in shapes:
+                        _compile_one(*shape)
             except Exception as e:  # warm-up is an optimization, never fatal
                 from ..utils.logger import log_swallowed
                 log_swallowed("poa: background warm-up compile failed "
@@ -1665,7 +1733,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
     def _launch_group(self, live, Lq, Lb, overrides=None):
         """Span-wrapped :meth:`_launch_group_impl` — the host-pack half
         of the consensus dispatch pipeline."""
-        with obs.span("poa.pack", windows=len(live)):
+        with self._pinned(), obs.span("poa.pack", windows=len(live)):
             return self._launch_group_impl(live, Lq, Lb, overrides)
 
     def _rounds(self, launch, Lq, Lb, steps, Lq2=0) -> None:
@@ -1675,21 +1743,22 @@ class TpuPoaConsensus(PallasDispatchMixin):
         RESOURCE_EXHAUSTED, which is exactly what the injected one
         mimics)."""
         faults.check("consensus.dispatch")
-        with obs.span("poa.dispatch", pairs=launch["B"]):
+        with self._pinned(), obs.span("poa.dispatch", pairs=launch["B"]):
             self._rounds_impl(launch, Lq, Lb, steps, Lq2)
 
     def _finish_group(self, launch, trim: bool, results,
                       retried: bool = False, collect=None) -> None:
         """Span-wrapped :meth:`_finish_group_impl` — the blocking fetch
         + decode half (a retry re-dispatch nests under this span)."""
-        with obs.span("poa.fetch", windows=launch["nWp"]):
+        with self._pinned(), obs.span("poa.fetch", windows=launch["nWp"]):
             self._finish_group_impl(launch, trim, results,
                                     retried=retried, collect=collect)
 
     def _run_stage_b(self, survivors, trim, results, Lq, Lb, steps,
                      Lq2, band) -> None:
         """Span-wrapped :meth:`_run_stage_b_impl`."""
-        with obs.span("poa.stage_b", windows=len(survivors)):
+        with self._pinned(), obs.span("poa.stage_b",
+                                      windows=len(survivors)):
             self._run_stage_b_impl(survivors, trim, results, Lq, Lb,
                                    steps, Lq2, band)
 

@@ -422,7 +422,7 @@ def test_resume_adopts_stored_plan_when_replan_drifts(assembly, tmp_path,
                       keep_work_dir=True)
 
     def drifted(index, n_shards=0, max_ram_bytes=0, max_target_bytes=0,
-                base_rss=0):
+                base_rss=0, **kw):
         return real_plan(index, n_shards=2)  # simulated RSS-shifted plan
 
     monkeypatch.setattr(runner_mod, "plan_shards", drifted)
