@@ -729,6 +729,116 @@ def bench_multichip():
     return out
 
 
+def bench_service():
+    """Resident polishing service (round 14, ROADMAP item 3): p50/p95
+    job latency across ``RACON_TPU_BENCH_SERVICE_JOBS`` (default 100)
+    sequential submissions of a ``RACON_TPU_BENCH_SERVICE``-Mbp
+    (default 5) polish job to ONE resident ``racon --serve`` server,
+    with a cold one-shot CLI baseline for the speedup claim and a
+    byte-identity assert against it.  The acceptance metric:
+    ``service_compile_fraction`` — the p50 of per-job measured XLA
+    compile seconds over job wall, from job #2 on — must be < 0.1
+    (latency dominated by compute, not compile).  0 disables."""
+    import os
+    import statistics
+    import subprocess
+    import tempfile
+
+    from racon_tpu import flags as racon_flags
+
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_SERVICE")
+    if not mbp:
+        return {}
+    n_jobs = max(2, racon_flags.get_int("RACON_TPU_BENCH_SERVICE_JOBS"))
+    from racon_tpu.serve.client import ServiceClient
+
+    sim_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "simulate.py")
+    out = {}
+    with tempfile.TemporaryDirectory(dir="/tmp") as td:
+        log(f"service bench: generating {mbp} Mbp workload...")
+        subprocess.run([sys.executable, sim_py, str(mbp), td,
+                        "--seed", "59"], check=True)
+        reads, paf, draft = (os.path.join(td, n) for n in
+                             ("reads.fastq", "ovl.paf", "draft.fasta"))
+        cache = os.path.join(td, "xla_cache")
+        env = dict(os.environ, RACON_TPU_COMPILE_CACHE=cache)
+
+        # cold baseline: a fresh one-shot process pays the full compile
+        log("service bench: cold one-shot CLI baseline...")
+        t0 = time.perf_counter()
+        want = subprocess.run(
+            [sys.executable, "-m", "racon_tpu", "-t", "4", "-c", "1",
+             "--tpualigner-batches", "1", reads, paf, draft],
+            stdout=subprocess.PIPE, check=True, env=env).stdout
+        cold_s = time.perf_counter() - t0
+        log(f"service bench: cold one-shot {cold_s:.1f}s")
+
+        sock = os.path.join(td, "racon.sock")
+        log(f"service bench: starting resident server "
+            f"({n_jobs} sequential submissions)...")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu", "--serve", sock,
+             "-t", "4", "-c", "1", "--tpualigner-batches", "1"],
+            env=env, stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.monotonic() + 300
+            while not os.path.exists(sock):
+                if time.monotonic() > deadline or \
+                        server.poll() is not None:
+                    raise RuntimeError("resident server did not start")
+                time.sleep(0.2)
+            lat, frac = [], []
+            spec = {"sequences": reads, "overlaps": paf,
+                    "target_sequences": draft, "threads": 4}
+            for k in range(n_jobs):
+                t0 = time.perf_counter()
+                with ServiceClient(sock, timeout_s=3600) as c:
+                    job = c.submit(spec)
+                    assert job.get("ok"), job
+                    header, payload = c.result(job["job"],
+                                               timeout_s=3600)
+                wall = time.perf_counter() - t0
+                assert header.get("ok"), header
+                assert payload == want, \
+                    f"job {k} diverged from the one-shot CLI output"
+                lat.append(wall)
+                frac.append(header.get("compile_s", 0.0)
+                            / max(header.get("wall_s", wall), 1e-9))
+                if k in (0, 1) or (k + 1) % 20 == 0:
+                    log(f"service bench: job {k + 1}/{n_jobs} "
+                        f"{wall:.2f}s (compile "
+                        f"{header.get('compile_s', 0.0):.2f}s)")
+            with ServiceClient(sock, timeout_s=60) as c:
+                c.shutdown()
+            server.wait(timeout=120)
+        finally:
+            if server.poll() is None:
+                server.kill()
+                server.wait()
+        warm_lat = sorted(lat[1:])  # job #1 pays any residual compile
+        p50 = statistics.median(warm_lat)
+        p95 = warm_lat[min(len(warm_lat) - 1,
+                           int(0.95 * len(warm_lat)))]
+        compile_fraction = statistics.median(frac[1:])
+        log(f"service bench: p50 {p50:.2f}s p95 {p95:.2f}s "
+            f"(cold one-shot {cold_s:.1f}s, "
+            f"compile fraction {compile_fraction:.4f})")
+        assert compile_fraction < 0.1, (
+            f"warm jobs are still compile-dominated "
+            f"(service_compile_fraction={compile_fraction:.3f})")
+        out.update(
+            service_mbp=mbp, service_jobs=n_jobs,
+            service_p50_s=round(p50, 3),
+            service_p95_s=round(p95, 3),
+            service_first_job_s=round(lat[0], 3),
+            service_compile_fraction=round(compile_fraction, 4),
+            service_cold_oneshot_s=round(cold_s, 2),
+            service_speedup_vs_cold=round(cold_s / p50, 2),
+            service_identity="byte-identical")
+    return out
+
+
 def bench_parse():
     """Ingest throughput (VERDICT r3: parse must stay <10% of wall at
     >=100 Mbp inputs): ~100 MB of concatenated λ-phage FASTQ and ~100 MB
@@ -783,6 +893,7 @@ def main():
     pipeline_metrics = bench_pipeline()
     shard_metrics = bench_shards()
     multichip_metrics = bench_multichip()
+    service_metrics = bench_service()
     parse_metrics = bench_parse()
 
     total_bases = sum(len(w.sequences[0]) for w in windows)
@@ -802,6 +913,7 @@ def main():
         **pipeline_metrics,  # full-pipeline Mbp/s + CPU baseline
         **shard_metrics,  # streaming shard-runner scaling curve
         **multichip_metrics,  # Mbp/s-vs-chips curve + identity assert
+        **service_metrics,  # resident-service p50/p95 + compile fraction
         **parse_metrics,
         "device": str(jax.devices()[0]),
     }

@@ -66,6 +66,15 @@ python -m pytest tests/test_faults.py -q
 python -m tools.analysis --quiet racon_tpu/parallel racon_tpu/exec \
   tests/test_topology.py
 python -m pytest tests/test_topology.py tests/test_parallel.py -q
+# resident-service shard (fail-fast, round 14): graftlint gate over the
+# serve package, then the service suite — protocol round-trip, three
+# concurrent jobs byte-identical to their one-shot CLI runs, admission
+# rejects-with-reason, the per-job fault ladder with server survival,
+# job-scoped metrics disjointness (the clear_run fix) and the warm-path
+# compile-amortization claim on the device engine
+python -m tools.analysis --quiet racon_tpu/serve racon_tpu/obs \
+  tests/test_serve.py
+python -m pytest tests/test_serve.py -q
 # observability shard (fail-fast, round 11): graftlint gate over the
 # obs package and every span-instrumented producer (span-discipline +
 # the 5 older rules), then the tracer/registry/report suite — trace
@@ -78,6 +87,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
   --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
   --ignore=tests/test_obs.py --ignore=tests/test_faults.py \
+  --ignore=tests/test_serve.py \
   --ignore=tests/test_topology.py --ignore=tests/test_parallel.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime

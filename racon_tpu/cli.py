@@ -24,12 +24,18 @@ def build_parser() -> argparse.ArgumentParser:
         prog="racon",
         description="consensus module for raw de novo DNA assembly of long "
                     "uncorrected reads (TPU-native implementation)")
-    p.add_argument("sequences", help="FASTA/FASTQ file (may be gzipped) with "
-                                     "sequences used for correction")
-    p.add_argument("overlaps", help="MHAP/PAF/SAM file (may be gzipped) with "
-                                    "overlaps between sequences and targets")
-    p.add_argument("target_sequences", help="FASTA/FASTQ file (may be "
-                                            "gzipped) with targets to correct")
+    # positionals are optional ONLY because --serve runs without them;
+    # every polishing mode (one-shot, sharded, --submit) still requires
+    # all three — enforced in main() with the reference's error text
+    p.add_argument("sequences", nargs="?", default=None,
+                   help="FASTA/FASTQ file (may be gzipped) with "
+                        "sequences used for correction")
+    p.add_argument("overlaps", nargs="?", default=None,
+                   help="MHAP/PAF/SAM file (may be gzipped) with "
+                        "overlaps between sequences and targets")
+    p.add_argument("target_sequences", nargs="?", default=None,
+                   help="FASTA/FASTQ file (may be "
+                        "gzipped) with targets to correct")
     p.add_argument("-u", "--include-unpolished", action="store_true",
                    help="output unpolished target sequences")
     p.add_argument("-f", "--fragment-correction", action="store_true",
@@ -131,6 +137,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "run; independently launched racon processes "
                         "sharing one --shard-dir cooperate the same "
                         "way (implies the streaming shard runner)")
+    # resident polishing service (racon_tpu.serve): one warm engine
+    # pool amortizes the cold XLA compile across every job it ever runs
+    p.add_argument("--serve", metavar="SOCK", default=None,
+                   help="run as a resident polishing service on the "
+                        "unix socket SOCK (no positional inputs): a "
+                        "warm per-chip engine pool executes submitted "
+                        "jobs through the normal pipeline, so a job's "
+                        "latency is compute, not the one-shot cold "
+                        "compile; -m/-x/-g/-b fix the resident engine "
+                        "profile, --serve-budget bounds the in-flight "
+                        "job footprint (see README 'Polishing as a "
+                        "service')")
+    p.add_argument("--submit", metavar="SOCK", default=None,
+                   help="submit this invocation as a job to the "
+                        "resident service listening on SOCK and stream "
+                        "the polished FASTA to stdout — byte-identical "
+                        "to running the same command one-shot")
+    p.add_argument("--serve-budget", metavar="SIZE", default=None,
+                   help="admission budget for --serve: the summed "
+                        "resident-footprint estimate of running jobs "
+                        "stays under SIZE (plain number = MB; K/M/G/T "
+                        "suffixes; default RACON_TPU_SERVE_BUDGET)")
     # internal: a spawned cooperating worker — adopts the primary's
     # manifest, claims/polishes shards, emits no merged FASTA
     p.add_argument("--exec-secondary", action="store_true",
@@ -304,6 +332,53 @@ def main(argv=None) -> int:
         # starts)
         from . import ops
         ops.configure_compile_cache(args.compile_cache)
+
+    if args.serve:
+        if args.sequences or args.overlaps or args.target_sequences:
+            parser.error("--serve takes no positional inputs (jobs "
+                         "submit theirs over the socket)")
+        if args.submit:
+            parser.error("--serve and --submit are mutually exclusive")
+        from .exec import parse_ram
+        from .serve.service import PolishServer
+        server = PolishServer(
+            args.serve,
+            match=args.match, mismatch=args.mismatch, gap=args.gap,
+            banded=args.tpu_banded_alignment,
+            num_threads=args.threads,
+            aligner_backend="tpu" if args.tpualigner_batches > 0
+            else "auto",
+            consensus_backend="tpu" if args.tpupoa_batches > 0
+            else "auto",
+            aligner_batches=max(1, args.tpualigner_batches),
+            consensus_batches=max(1, args.tpupoa_batches),
+            chips=args.chips,
+            budget_bytes=parse_ram(args.serve_budget)
+            if args.serve_budget else 0)
+        try:
+            return server.serve_forever()
+        except KeyboardInterrupt:
+            server.shutdown()
+            return 0
+        except (ValueError, RuntimeError, OSError) as e:
+            print(f"[racon_tpu::serve] error: {e}", file=sys.stderr)
+            return 1
+
+    # every polishing mode (one-shot, sharded, --submit) needs the
+    # input triple — only --serve runs without it
+    if not (args.sequences and args.overlaps and args.target_sequences):
+        parser.error("the following arguments are required: sequences, "
+                     "overlaps, target_sequences")
+
+    if args.submit:
+        from .serve import client as serve_client
+        try:
+            return serve_client.submit_and_stream(
+                args.submit, serve_client.spec_from_args(args),
+                sys.stdout.buffer, report_path=report_path)
+        except (ValueError, RuntimeError, OSError) as e:
+            print(f"[racon_tpu::serve] error: {e}", file=sys.stderr)
+            return 1
 
     # RACON_TPU_CHIPS is documented as the --chips env equivalent, so
     # it must also route the run into the shard runner (where the chip

@@ -777,7 +777,14 @@ class Polisher:
                              time.perf_counter() - t_put)
             metrics.set_gauge("queue.depth", ranges.qsize())
 
+        # job-scoped metrics (round 14): the scope is thread-local, so
+        # the producer thread must re-declare the caller's — otherwise
+        # a service job's queue/build telemetry would leak into the
+        # global namespace and collide with concurrent jobs'
+        job_scope = metrics.get_scope()
+
         def produce():
+            metrics.set_scope(job_scope)
             try:
                 t_cpu = time.thread_time()
                 with obs.span("build.windows"):

@@ -33,18 +33,21 @@ from ..obs.metrics import peak_rss_bytes  # noqa: F401  (re-export: the
 #   rampler and the runner keep importing it from here)
 
 
-def retrace_summary() -> str:
-    deltas = metrics.group("retrace.")
+def retrace_summary(scope: str = "") -> str:
+    """Per-phase jit-retrace deltas as a heartbeat field; ``scope``
+    renders one service job's numbers (``metrics.job_scope``)."""
+    deltas = metrics.group(scope + "retrace.")
     if not deltas:
         return "-"
     return ",".join(f"{k}={v}" for k, v in sorted(deltas.items()))
 
 
-def pack_summary_str() -> str:
+def pack_summary_str(scope: str = "") -> str:
     """Real packing occupancy of the consensus pair arenas (round 10):
     occupied/total lanes and mean windows per dispatched group, derived
-    from the registry counters (``-`` before any launch)."""
-    pack = metrics.pack_summary()
+    from the registry counters (``-`` before any launch); ``scope``
+    renders one service job's numbers."""
+    pack = metrics.pack_summary(scope)
     if not pack["groups"]:
         return "-"
     return (f"{pack['pack_efficiency']:.2f}eff,"
@@ -52,10 +55,11 @@ def pack_summary_str() -> str:
             f"{pack['groups']}g")
 
 
-def queue_summary_str() -> str:
+def queue_summary_str(scope: str = "") -> str:
     """Bounded init->polish queue health: current depth plus cumulative
-    producer/consumer stall seconds (``-`` before any pipelined run)."""
-    q = metrics.queue_summary()
+    producer/consumer stall seconds (``-`` before any pipelined run);
+    ``scope`` renders one service job's numbers."""
+    q = metrics.queue_summary(scope)
     if not q["stall_s"] and not q["depth"]:
         return "-"
     return f"d={int(q['depth'])},stall={q['stall_s']:.1f}s"

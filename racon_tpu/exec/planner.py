@@ -59,6 +59,35 @@ MESH_DOMINANT_FACTOR = 1.5
 MESH_DEVICE = -1  # ShardPlan.devices marker: mesh over all chips
 
 
+# a .gz input's resident cost is driven by its DECOMPRESSED bytes;
+# genomic FASTA/FASTQ/PAF compresses roughly 4:1 under gzip, so the
+# admission estimate inflates compressed sizes by this factor (erring
+# high keeps the budget a promise, same bias as the shard cost model)
+GZ_INFLATE_FACTOR = 4
+
+
+def input_cost_bytes(path: str) -> int:
+    """Approximate decompressed size of one input file (the admission
+    estimator's raw material — file size, gz-inflated)."""
+    import os
+
+    size = os.path.getsize(path)
+    return size * GZ_INFLATE_FACTOR if path.endswith(".gz") else size
+
+
+def estimate_job_cost(sequences: str, overlaps: str,
+                      target_sequences: str) -> int:
+    """Resident-footprint estimate, in bytes, for polishing ONE input
+    triple as a single job — the cost model :func:`plan_shards` applies
+    per contig, collapsed to whole files for the resident service's
+    admission control (``racon_tpu.serve``): same weights, same
+    deliberate over-estimation (reject one job too many rather than
+    OOM one job too few)."""
+    return (2 * input_cost_bytes(target_sequences)
+            + 3 * input_cost_bytes(sequences)
+            + 2 * input_cost_bytes(overlaps))
+
+
 def parse_ram(text: str) -> int:
     """``--max-ram`` parser: plain numbers are megabytes, ``K``/``M``/
     ``G``/``T`` suffixes are explicit (``4G``, ``500M``)."""

@@ -109,22 +109,31 @@ class _ChipWorker:
     work per chip), an engine pair pinned to its local device, and —
     for slot 0 only — the mesh engines that run dominant-contig shards
     sharded over ALL chips. The legacy single-chip path is exactly one
-    unpinned slot whose worker id is the runner's own."""
+    unpinned slot whose worker id is the profile's own.
 
-    def __init__(self, runner: "ShardRunner", slot, pinned: bool):
-        self.runner = runner
+    ``profile`` is duck-typed — anything carrying the engine recipe
+    (``num_threads``, ``match``/``mismatch``/``gap``, ``banded``,
+    ``aligner_backend``/``consensus_backend``, ``aligner_batches``/
+    ``consensus_batches``), a ``worker`` identity string, and (for the
+    mesh slot only) ``_chip_slots()``.  :class:`ShardRunner` passes
+    itself; the resident polishing service (``racon_tpu.serve``) passes
+    its ``PolishServer`` so one warm, chip-pinned engine pool serves
+    both the shard drain loop and long-lived job execution."""
+
+    def __init__(self, profile, slot, pinned: bool):
+        self.profile = profile
         self.slot = slot                      # topology.ChipSlot
         self.ordinal = slot.ordinal
         self.device = slot.device if pinned else None
-        self.worker = (f"{runner.worker}#{slot.key}" if pinned
-                       else runner.worker)
+        self.worker = (f"{profile.worker}#{slot.key}" if pinned
+                       else profile.worker)
         self.can_mesh = slot.ordinal == 0
         self.engines = None
         self.cpu_engines = None
         self.mesh_engines = None
 
     def get_engines(self, cpu: bool, mesh: bool = False):
-        r = self.runner
+        r = self.profile
         if cpu:
             if self.cpu_engines is None:
                 self.cpu_engines = (

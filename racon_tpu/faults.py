@@ -28,8 +28,10 @@ tests (and for operators reproducing a production fault). The grammar::
 - *site* — a named injection point (:data:`KNOWN_SITES`): the
   consensus dispatch, the aligner fetch, the part-file write, the
   manifest write, the worker itself (``worker.kill`` SIGKILLs the
-  process — the chaos soak's crash source), and ``exec.polish`` (the
-  per-shard polish entry the legacy hook targets);
+  process — the chaos soak's crash source), ``exec.polish`` (the
+  per-shard polish entry the legacy hook targets), and
+  ``serve.polish`` (the resident polishing service's per-job attempt
+  entry — its ladder tests inject here);
 - *kind* — ``io`` (transient EIO), ``enospc`` (disk full), ``oom``
   (RESOURCE_EXHAUSTED), ``err`` (deterministic compute fault),
   ``stall`` (:class:`StallError`), ``kill`` (SIGKILL own process);
@@ -125,7 +127,8 @@ def classify(exc: BaseException) -> str:
 # --------------------------------------------------------------- injection
 
 KNOWN_SITES = ("consensus.dispatch", "align.fetch", "part.write",
-               "manifest.write", "worker.kill", "exec.polish")
+               "manifest.write", "worker.kill", "exec.polish",
+               "serve.polish")
 
 _KINDS = ("io", "enospc", "oom", "err", "stall", "kill")
 

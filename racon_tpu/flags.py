@@ -144,9 +144,9 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Seeded site-addressed fault injection: "
          "'site:kind[@N][*][%P],...' — sites consensus.dispatch / "
          "align.fetch / part.write / manifest.write / worker.kill / "
-         "exec.polish; kinds io, enospc, oom, err, stall, kill; @N "
-         "arms on the Nth hit, '*' keeps firing, %P fires with seeded "
-         "probability P (see racon_tpu/faults.py)."),
+         "exec.polish / serve.polish; kinds io, enospc, oom, err, "
+         "stall, kill; @N arms on the Nth hit, '*' keeps firing, %P "
+         "fires with seeded probability P (see racon_tpu/faults.py)."),
     Flag("RACON_TPU_FAULTS_SEED", "0", "int",
          "Seed for probabilistic (%P) fault-injection draws, so a "
          "chaos run replays deterministically."),
@@ -169,6 +169,25 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Base of the transient-fault exponential backoff (doubled "
          "per retry, deterministic jitter added; see the ladder in "
          "racon_tpu/exec/runner.py)."),
+    # --------------------------------------------- resident polishing service
+    Flag("RACON_TPU_SERVE_WARM_SHAPES", "500:131072:8192:8", "str",
+         "Expected-shape profile the resident service (racon --serve) "
+         "warm-compiles at startup, so job #1 is already warm: "
+         "comma-separated 'window_length:pairs:windows[:contigs]' "
+         "entries fed to the consensus engine's warmup_async on every "
+         "pool worker (empty disables the startup warm-up; jobs still "
+         "warm their own geometry on admission)."),
+    Flag("RACON_TPU_SERVE_BUDGET", "8G", "str",
+         "Resident service admission budget: the summed resident-"
+         "footprint estimate (the exec planner's cost model) of "
+         "running jobs is kept under this size, and a single job "
+         "estimated over it is rejected with the reason instead of "
+         "OOMing the server (plain number = MB; K/M/G/T suffixes; "
+         "the CLI --serve-budget flag overrides)."),
+    Flag("RACON_TPU_SERVE_QUEUE", "64", "int",
+         "Maximum queued (admitted, not yet running) jobs the "
+         "resident service holds before rejecting submissions with "
+         "'queue full'."),
     # -------------------------------------------------------- tests, bench
     Flag("RACON_TPU_SLOW", "0", "bool",
          "Enable the slow (tier-2) test set."),
@@ -193,6 +212,16 @@ REGISTRY: Dict[str, Flag] = _declare([
          "1-chip-vs-all-chips byte-identity assert; on a single-device "
          "host the points run on per-point virtual CPU meshes; 0 "
          "disables)."),
+    Flag("RACON_TPU_BENCH_SERVICE", "5", "float",
+         "bench.py resident-service workload size in Mbp: p50/p95 job "
+         "latency and compile fraction across sequential submissions "
+         "of one polish job to a resident racon --serve server, plus "
+         "a cold one-shot CLI baseline and a byte-identity assert "
+         "(0 disables)."),
+    Flag("RACON_TPU_BENCH_SERVICE_JOBS", "100", "int",
+         "How many sequential job submissions the resident-service "
+         "bench drives through one server (the acceptance metric's "
+         "sample size)."),
 ])
 
 

@@ -24,6 +24,19 @@ free (no jax, no numpy): importable from ``tests/conftest.py`` and
 ``utils/logger.py`` before any backend initializes.  Updates are a dict
 write under a lock — nanoseconds against the chunk/group granularity of
 every publishing site.
+
+**Job scopes** (round 14, the resident polishing service): a thread may
+declare a scope prefix (:func:`set_scope`, thread-local) and every
+write it makes from then on lands under ``<scope><name>`` instead of
+the plain name — ``job.7.align.dispatch`` rather than
+``align.dispatch``.  That is what lets N concurrent service jobs share
+the one registry without trampling each other: each job's worker thread
+(and the polisher threads it spawns, which inherit the scope
+explicitly) publishes into its own namespace, per-job reports read it
+back with :func:`group`/:func:`snapshot` under the scope, and
+:func:`clear_run` — whose prefixes never match a ``job.`` name — can no
+longer wipe another job's in-flight gauges.  Scoped metrics are dropped
+with :func:`clear_job` when the job record is retired.
 """
 
 from __future__ import annotations
@@ -39,21 +52,56 @@ _counters: Dict[str, Number] = {}
 _gauges: Dict[str, Number] = {}
 _timers: Dict[str, float] = {}
 
+# thread-local job scope: a prefix applied to every metric WRITE made
+# by the declaring thread (reads always take explicit names — a reader
+# aggregating per-job numbers passes the scope itself)
+_tls = threading.local()
+
+JOB_SCOPE_ROOT = "job."
+
+
+def job_scope(job_id) -> str:
+    """The canonical scope prefix for one service job
+    (``job.<id>.``)."""
+    return f"{JOB_SCOPE_ROOT}{job_id}."
+
+
+def set_scope(prefix: Optional[str]) -> None:
+    """Prefix every metric write from the CURRENT THREAD with
+    ``prefix`` (None/"" clears).  Thread-local and not inherited by
+    spawned threads — a parent that fans work out re-applies its scope
+    on the child (``Polisher.run`` does this for its layer-producer
+    thread)."""
+    _tls.scope = prefix or None
+
+
+def get_scope() -> Optional[str]:
+    """The current thread's write scope (None when unscoped)."""
+    return getattr(_tls, "scope", None)
+
+
+def _scoped(name: str) -> str:
+    s = getattr(_tls, "scope", None)
+    return s + name if s else name
+
 
 def inc(name: str, delta: Number = 1) -> None:
     """Add ``delta`` to counter ``name`` (created at 0)."""
+    name = _scoped(name)
     with _lock:
         _counters[name] = _counters.get(name, 0) + delta
 
 
 def set_gauge(name: str, value: Number) -> None:
     """Set gauge ``name`` to ``value`` (last write wins)."""
+    name = _scoped(name)
     with _lock:
         _gauges[name] = value
 
 
 def add_time(name: str, seconds: float) -> None:
     """Accumulate ``seconds`` onto timer ``name``."""
+    name = _scoped(name)
     with _lock:
         _timers[name] = _timers.get(name, 0.0) + seconds
 
@@ -113,31 +161,53 @@ def clear_run() -> None:
     """Drop every per-run metric (:data:`_RUN_PREFIXES`) — called at
     run boundaries (``obs.begin``, ``ShardRunner.run``, bench legs) so
     back-to-back runs in one process each report their own numbers
-    instead of process-lifetime accumulations."""
+    instead of process-lifetime accumulations.  Job-scoped metrics
+    (``job.<id>.*``) are deliberately NOT touched: a run boundary in
+    one thread (a service job starting, a bench leg) must never wipe a
+    concurrent job's in-flight gauges — that is :func:`clear_job`'s
+    call, made by the job's own lifecycle."""
     for prefix in _RUN_PREFIXES:
         clear(prefix)
 
 
-def snapshot() -> Dict[str, Dict[str, Number]]:
-    """Point-in-time copy of the whole registry (the run report embeds
-    it verbatim)."""
+def clear_job(job_id) -> None:
+    """Drop every metric one service job published under its scope
+    (the job-scoped analog of :func:`clear_run`)."""
+    clear(job_scope(job_id))
+
+
+def snapshot(scope: Optional[str] = None) -> Dict[str, Dict[str, Number]]:
+    """Point-in-time copy of the registry (the run report embeds it
+    verbatim).  With ``scope``, only that scope's metrics are returned,
+    keyed by their unscoped names — the per-job report's view."""
     with _lock:
+        if scope:
+            return {
+                "counters": {k[len(scope):]: v
+                             for k, v in _counters.items()
+                             if k.startswith(scope)},
+                "gauges": {k[len(scope):]: v for k, v in _gauges.items()
+                           if k.startswith(scope)},
+                "timers": {k[len(scope):]: round(v, 6)
+                           for k, v in _timers.items()
+                           if k.startswith(scope)},
+            }
         return {"counters": dict(_counters), "gauges": dict(_gauges),
                 "timers": {k: round(v, 6) for k, v in _timers.items()}}
 
 
 # ------------------------------------------------------------ derived views
 
-def pack_summary() -> Dict[str, Number]:
+def pack_summary(scope: str = "") -> Dict[str, Number]:
     """Pair-arena occupancy derived from the ``consensus.*`` counters
     the device engine publishes per launch — the registry twin of
     ``TpuPoaConsensus.pack_metrics()``, cumulative since the last run
-    boundary (:func:`clear_run`)."""
+    boundary (:func:`clear_run`).  ``scope`` reads one job's numbers."""
     with _lock:
-        tot = _counters.get("consensus.lanes_total", 0)
-        occ = _counters.get("consensus.lanes_occupied", 0)
-        grp = _counters.get("consensus.groups", 0)
-        wins = _counters.get("consensus.group_windows", 0)
+        tot = _counters.get(scope + "consensus.lanes_total", 0)
+        occ = _counters.get(scope + "consensus.lanes_occupied", 0)
+        grp = _counters.get(scope + "consensus.groups", 0)
+        wins = _counters.get(scope + "consensus.group_windows", 0)
     eff = occ / tot if tot else 0.0
     return {"pack_efficiency": round(eff, 4),
             "pad_fraction": round(1.0 - eff, 4) if tot else 0.0,
@@ -145,20 +215,21 @@ def pack_summary() -> Dict[str, Number]:
             "groups": grp}
 
 
-def queue_summary() -> Dict[str, Number]:
+def queue_summary(scope: str = "") -> Dict[str, Number]:
     """The pipelined ``Polisher.run()`` bounded-queue health metrics:
-    current depth plus accumulated producer/consumer blocking time."""
+    current depth plus accumulated producer/consumer blocking time.
+    ``scope`` reads one job's numbers."""
     with _lock:
-        depth = _gauges.get("queue.depth", 0)
-        put_s = _timers.get("queue.producer_wait_s", 0.0)
-        get_s = _timers.get("queue.consumer_wait_s", 0.0)
+        depth = _gauges.get(scope + "queue.depth", 0)
+        put_s = _timers.get(scope + "queue.producer_wait_s", 0.0)
+        get_s = _timers.get(scope + "queue.consumer_wait_s", 0.0)
     return {"depth": depth,
             "producer_wait_s": round(put_s, 3),
             "consumer_wait_s": round(get_s, 3),
             "stall_s": round(put_s + get_s, 3)}
 
 
-def device_summary() -> Dict[str, Dict[str, Number]]:
+def device_summary(scope: str = "") -> Dict[str, Dict[str, Number]]:
     """Per-chip telemetry rows derived from the ``device.<ordinal>.*``
     metrics the in-process chip workers publish: shard/Mbp counters,
     polish seconds, and the per-thread span-timer mirrors
@@ -166,7 +237,7 @@ def device_summary() -> Dict[str, Dict[str, Number]]:
     Empty for single-chip runs — the run report embeds this as its
     ``devices`` section."""
     rows: Dict[str, Dict[str, Number]] = {}
-    for k, v in group("device.").items():
+    for k, v in group(scope + "device.").items():
         dev, _, metric = k.partition(".")
         if not dev or not metric:
             continue

@@ -32,14 +32,21 @@ from . import metrics
 # shard/Mbp/dispatch/fetch rows from the in-process chip scheduler;
 # empty object on single-chip runs) and shard rows grew "device" (the
 # chip ordinal a shard ran on; -1 = mesh-sharded over all chips)
-SCHEMA_VERSION = 3
+# v4 (round 14): kind may be "job" — the resident polishing service
+# returns one report per submitted job alongside its result, built
+# from that job's metric scope (``job.<id>.*``), and "dispatch_fetch"
+# grew "compile_s" (real XLA compile seconds via jax.monitoring — THE
+# number the service exists to amortize)
+SCHEMA_VERSION = 4
+
+KINDS = ("cli", "exec", "job")
 
 _NUM = (int, float)
 
 # top-level schema: key -> (accepted types, required)
 _TOP = {
     "schema_version": (int, True),
-    "kind": (str, True),                # "cli" | "exec"
+    "kind": (str, True),                # "cli" | "exec" | "job"
     "argv": (list, False),
     "started_unix": (_NUM, True),
     "wall_s": (_NUM, True),
@@ -83,10 +90,16 @@ _SHARD_ROW = {
 def build_report(kind: str, *, argv: Optional[list] = None,
                  started_unix: float = 0.0, wall_s: float = 0.0,
                  phases: Optional[Dict[str, float]] = None,
-                 shards: Optional[List[dict]] = None) -> dict:
+                 shards: Optional[List[dict]] = None,
+                 scope: str = "") -> dict:
     """Assemble a report from the metrics registry plus the caller's
     phase timings (``Polisher.timings``) and, for exec runs, the
-    manifest's shard entries (:func:`shard_row` extracts the row)."""
+    manifest's shard entries (:func:`shard_row` extracts the row).
+
+    ``scope`` builds the report from ONE metric scope instead of the
+    global namespace — the resident polishing service passes the job's
+    ``job.<id>.`` prefix, so concurrent jobs' reports stay disjoint
+    (every embedded name is unscoped; the scope is a read filter)."""
     rep = {
         "schema_version": SCHEMA_VERSION,
         "kind": kind,
@@ -96,40 +109,49 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         "phases": {str(k): round(float(v), 6)
                    for k, v in (phases or {}).items()},
         "dispatch_fetch": {
-            "align_dispatch_s": round(metrics.timer_s("align.dispatch"), 3),
-            "align_fetch_s": round(metrics.timer_s("align.fetch"), 3),
-            "consensus_pack_s": round(metrics.timer_s("poa.pack"), 3),
+            "align_dispatch_s": round(
+                metrics.timer_s(scope + "align.dispatch"), 3),
+            "align_fetch_s": round(
+                metrics.timer_s(scope + "align.fetch"), 3),
+            "consensus_pack_s": round(
+                metrics.timer_s(scope + "poa.pack"), 3),
             "consensus_dispatch_s": round(
-                metrics.timer_s("poa.dispatch"), 3),
-            "consensus_fetch_s": round(metrics.timer_s("poa.fetch"), 3),
+                metrics.timer_s(scope + "poa.dispatch"), 3),
+            "consensus_fetch_s": round(
+                metrics.timer_s(scope + "poa.fetch"), 3),
+            # real XLA compile seconds attributed to this run/job (the
+            # jax.monitoring hook the service arms; 0 when unarmed)
+            "compile_s": round(
+                metrics.timer_s(scope + "compile.jax_s"), 3),
         },
-        "pack": metrics.pack_summary(),
+        "pack": metrics.pack_summary(scope),
         # process-lifetime totals (the "retrace." gauges hold only the
         # most recent per-phase delta and the exec runner clears them
         # between shards for per-shard attribution; the "_total"
         # counters accumulate across the whole run — identical for
         # single-polisher cli runs)
-        "retrace": (metrics.group("retrace_total.")
-                    or metrics.group("retrace.")),
-        "queue": metrics.queue_summary(),
-        "swallowed": {k: int(v)
-                      for k, v in metrics.group("swallowed.").items()},
+        "retrace": (metrics.group(scope + "retrace_total.")
+                    or metrics.group(scope + "retrace.")),
+        "queue": metrics.queue_summary(scope),
+        "swallowed": {k: int(v) for k, v in
+                      metrics.group(scope + "swallowed.").items()},
         # fault-tolerance visibility: per-class fault counts, injected-
         # site counts and backpressure halvings (``faults.*``) plus the
         # lease lifecycle (``lease.claimed/expired/reclaimed/lost``) —
         # every ladder decision also sits per-attempt in its shard row
         "faults": {
-            **{k: int(v) for k, v in metrics.group("faults.").items()},
+            **{k: int(v)
+               for k, v in metrics.group(scope + "faults.").items()},
             **{f"lease.{k}": int(v)
-               for k, v in metrics.group("lease.").items()},
+               for k, v in metrics.group(scope + "lease.").items()},
         },
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and
         # the span-timer mirrors (dispatch/fetch per chip). {} on
         # single-chip runs.
-        "devices": metrics.device_summary(),
+        "devices": metrics.device_summary(scope),
         "peak_rss_bytes": metrics.peak_rss_bytes(),
-        "metrics": metrics.snapshot(),
+        "metrics": metrics.snapshot(scope or None),
     }
     if shards is not None:
         rep["shards"] = [shard_row(e) for e in shards]
@@ -176,8 +198,8 @@ def validate_report(rep) -> List[str]:
         errors.append(f"unknown key {key!r}")
     if errors:
         return errors
-    if rep["kind"] not in ("cli", "exec"):
-        errors.append(f"kind {rep['kind']!r} not in ('cli', 'exec')")
+    if rep["kind"] not in KINDS:
+        errors.append(f"kind {rep['kind']!r} not in {KINDS}")
     for key in ("phases", "dispatch_fetch", "retrace", "swallowed",
                 "faults"):
         _check_numeric_dict(errors, rep[key], key)

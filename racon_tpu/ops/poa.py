@@ -1295,6 +1295,12 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # consensus-side analog of TpuAligner._shadow
         self._shadow = sanitize.ShadowSampler()
         self._warmup = None
+        # shapes already submitted for warm-up compilation: the
+        # resident polishing service calls warmup_async per admitted
+        # job (so a NEW geometry starts compiling while the job waits
+        # in queue), and repeat geometries — the service's whole point
+        # — must cost nothing, not a redundant background compile
+        self._warmed_shapes: set = set()
         # wavefront_steps: executed (post-gating) DP anti-diagonal steps,
         # the honest numerator for utilization estimates (bench.py);
         # lanes_occupied/lanes_total/groups/group_windows: real packing
@@ -1672,12 +1678,17 @@ class TpuPoaConsensus(PallasDispatchMixin):
         wrong estimate wastes a background compile and nothing else:
         run()'s own shapes still compile on first use. Returns the
         thread (for tests), or None when skipped (mesh runs, zero
-        estimates)."""
+        estimates, every derived shape already warmed — repeat calls
+        with the same geometry are deliberately free, so the resident
+        service can warm per admitted job)."""
         if self.mesh is not None or est_pairs <= 0:
             return None
-        shapes = self._warmup_shapes(window_length, est_pairs,
-                                     est_windows, est_layer_len,
-                                     est_contigs)
+        shapes = [s for s in self._warmup_shapes(
+            window_length, est_pairs, est_windows, est_layer_len,
+            est_contigs) if s not in self._warmed_shapes]
+        if not shapes:
+            return None
+        self._warmed_shapes.update(shapes)
 
         def _compile_one(Lq, Lb, band, steps, Lq2, B, nWp, rounds):
             # the availability probes themselves compile and run

@@ -1,0 +1,18 @@
+"""racon_tpu.serve — the resident polishing service (ROADMAP item 3).
+
+A long-lived server process (``racon --serve SOCK`` /
+``python -m racon_tpu.serve SOCK``) keeps one warm engine pool per
+local chip and executes submitted polish jobs through the existing
+:meth:`Polisher.run` pipeline with those engines injected, so a job's
+latency is compute, not the 16–80 s cold XLA compile every one-shot
+invocation pays.  Jobs arrive over a newline-JSON unix-socket protocol
+(:mod:`.protocol`), pass admission control driven by the exec planner's
+cost model, walk the round-12 degradation ladder on faults, and return
+their polished FASTA byte-identical to a one-shot CLI run, alongside a
+per-job schema-validated run report (:mod:`.service`).  The thin
+client (``racon --submit SOCK ...``, :mod:`.client`) streams the FASTA
+to stdout exactly like the one-shot CLI would.
+"""
+
+from .client import ServiceClient, submit_and_stream  # noqa: F401
+from .service import PolishServer  # noqa: F401

@@ -1,0 +1,11 @@
+"""``python -m racon_tpu.serve SOCK [racon options]`` — the module
+entry for the resident polishing service; equivalent to
+``racon --serve SOCK [options]`` (the options set the server's engine
+profile: -m/-x/-g/-b, -t, -c, --tpualigner-batches, --chips,
+--serve-budget, --compile-cache)."""
+
+import sys
+
+from ..cli import main
+
+sys.exit(main(["--serve"] + sys.argv[1:]))

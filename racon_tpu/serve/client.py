@@ -1,0 +1,147 @@
+"""Client side of the resident polishing service: a thin connection
+wrapper plus the ``racon --submit`` entry that streams a job's polished
+FASTA back **byte-identical** to a one-shot CLI run's stdout.
+
+The client never re-encodes the payload: the server announces
+``"bytes": N`` and the client copies exactly N raw bytes to the output
+stream — the byte-identity contract is structural, not best-effort.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from typing import Optional, Tuple
+
+from . import protocol
+
+
+class ServiceClient:
+    """One connection to a :class:`PolishServer` socket.  Usable as a
+    context manager; every helper returns the decoded response header
+    (and :meth:`result` the payload too)."""
+
+    def __init__(self, socket_path: str, timeout_s: float = 600.0):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.settimeout(timeout_s)
+        self.sock.connect(socket_path)
+        self.rfile = self.sock.makefile("rb")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def close(self) -> None:
+        self.rfile.close()
+        self.sock.close()
+
+    def _roundtrip(self, msg: dict) -> dict:
+        protocol.send_msg(self.sock, msg)
+        resp = protocol.read_msg(self.rfile)
+        if resp is None:
+            raise ConnectionError(
+                "server closed the connection mid-request")
+        return resp
+
+    def ping(self) -> dict:
+        return self._roundtrip({"op": "ping"})
+
+    def stats(self) -> dict:
+        return self._roundtrip({"op": "stats"})
+
+    def submit(self, spec: dict) -> dict:
+        return self._roundtrip({"op": "submit", "spec": spec})
+
+    def status(self, job_id: str) -> dict:
+        return self._roundtrip({"op": "status", "job": job_id})
+
+    def cancel(self, job_id: str) -> dict:
+        return self._roundtrip({"op": "cancel", "job": job_id})
+
+    def shutdown(self) -> dict:
+        return self._roundtrip({"op": "shutdown"})
+
+    def result(self, job_id: str, timeout_s: Optional[float] = None,
+               keep: bool = False) -> Tuple[dict, Optional[bytes]]:
+        """Block until the job is terminal; returns ``(header,
+        payload)`` — payload is the polished FASTA bytes on success,
+        None on failure/timeout (the header carries the reason and the
+        per-job run report either way).  The default server-side wait
+        is derived from THIS connection's socket timeout (minus a
+        margin), so the server answers before the client's own read
+        would give up — an explicit ``timeout_s`` longer than the
+        socket timeout cannot be honored and is clamped the same
+        way."""
+        sock_timeout = self.sock.gettimeout()
+        if sock_timeout is not None:
+            bound = max(1.0, sock_timeout - 5.0)
+            timeout_s = bound if timeout_s is None \
+                else min(timeout_s, bound)
+        elif timeout_s is None:
+            timeout_s = 3600.0
+        header = self._roundtrip({"op": "result", "job": job_id,
+                                  "timeout_s": timeout_s,
+                                  "keep": keep})
+        if not header.get("ok") or "bytes" not in header:
+            return header, None
+        payload = protocol.read_exact(self.rfile, int(header["bytes"]))
+        return header, payload
+
+
+def spec_from_args(args) -> dict:
+    """A submit spec from the parsed ``racon`` CLI namespace — the
+    one-shot option surface forwarded verbatim, so ``--submit`` output
+    matches the equivalent one-shot invocation byte for byte."""
+    return {
+        "sequences": os.path.abspath(args.sequences),
+        "overlaps": os.path.abspath(args.overlaps),
+        "target_sequences": os.path.abspath(args.target_sequences),
+        "fragment_correction": bool(args.fragment_correction),
+        "window_length": args.window_length,
+        "quality_threshold": args.quality_threshold,
+        "error_threshold": args.error_threshold,
+        "no_trimming": bool(args.no_trimming),
+        "match": args.match, "mismatch": args.mismatch,
+        "gap": args.gap,
+        "banded": bool(args.tpu_banded_alignment),
+        "threads": args.threads,
+        "include_unpolished": bool(args.include_unpolished),
+    }
+
+
+def submit_and_stream(socket_path: str, spec: dict, out,
+                      report_path: Optional[str] = None,
+                      timeout_s: float = 3600.0) -> int:
+    """The ``racon --submit`` flow: submit, wait, stream the FASTA to
+    ``out``, optionally persist the per-job run report.  Returns the
+    process exit code (0 = polished bytes were streamed)."""
+    with ServiceClient(socket_path, timeout_s=timeout_s) as client:
+        resp = client.submit(spec)
+        if not resp.get("ok"):
+            print(f"[racon_tpu::serve] submission rejected: "
+                  f"{resp.get('error')}", file=sys.stderr)
+            return 1
+        job_id = resp["job"]
+        print(f"[racon_tpu::serve] job {job_id} submitted "
+              f"({resp.get('cost_bytes', 0) >> 20} MB estimated)",
+              file=sys.stderr)
+        header, payload = client.result(job_id, timeout_s=timeout_s)
+    if report_path and header.get("report"):
+        from ..obs import report as obs_report
+        obs_report.write_report(report_path, header["report"])
+    if payload is None:
+        print(f"[racon_tpu::serve] job {job_id} "
+              f"{header.get('state')}: {header.get('error')}",
+              file=sys.stderr)
+        return 1
+    out.write(payload)
+    out.flush()
+    print(f"[racon_tpu::serve] job {job_id} done in "
+          f"{header.get('wall_s', 0.0):.2f}s "
+          f"(compile {header.get('compile_s', 0.0):.2f}s, "
+          f"engine={header.get('engine', '-')})", file=sys.stderr)
+    return 0

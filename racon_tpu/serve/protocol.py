@@ -1,0 +1,129 @@
+"""The resident polishing service's wire protocol: newline-delimited
+JSON over a unix-domain stream socket, with one raw-bytes escape for the
+polished FASTA payload.
+
+Every request and every response is ONE ``\\n``-terminated JSON object.
+A connection may carry any number of requests; responses come back in
+request order.  The single exception to the line discipline: a
+successful ``result`` response announces ``"bytes": N`` in its header
+line and is followed by exactly N raw bytes of polished FASTA — the
+client reads them verbatim (no re-encoding, no base64), which is what
+keeps a ``racon --submit`` stream byte-identical to the one-shot CLI's
+stdout.
+
+Requests (``op`` selects):
+
+- ``ping`` — liveness; response echoes server identity and uptime.
+- ``submit`` — a job spec (input paths + polishing options, see
+  :data:`SPEC_KEYS`); response carries the job id, or ``ok: false``
+  with the admission-rejection reason.
+- ``status`` — one job's state (queued/running/done/failed/cancelled),
+  queue position, cost estimate, ladder attempts so far.
+- ``result`` — blocks (bounded by ``timeout_s``) until the job is
+  terminal, then returns the header + FASTA payload (and the per-job
+  ``run_report`` alongside).
+- ``cancel`` — cancels a QUEUED job; a running job cannot be safely
+  interrupted mid-dispatch and the response says so.
+- ``stats`` — server-level counters (jobs done/failed, in-flight
+  footprint, queue depth).
+- ``shutdown`` — stop accepting, finish the running jobs, exit.
+
+Paths in a job spec are server-local: the socket is unix-domain, so
+client and server share a filesystem by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Optional, Tuple
+
+# every key a submit spec may carry; ("option", default) pairs mirror
+# the CLI's polishing knobs (cli.build_parser) so --submit round-trips
+# them verbatim
+SPEC_DEFAULTS = {
+    "fragment_correction": False,
+    "window_length": 500,
+    "quality_threshold": 10.0,
+    "error_threshold": 0.3,
+    "no_trimming": False,
+    "match": 3, "mismatch": -5, "gap": -4,
+    "banded": False,
+    "threads": 1,
+    "include_unpolished": False,
+}
+SPEC_PATHS = ("sequences", "overlaps", "target_sequences")
+SPEC_KEYS = SPEC_PATHS + tuple(SPEC_DEFAULTS)
+
+
+def encode(obj: dict) -> bytes:
+    """One protocol line (compact separators keep headers small)."""
+    return json.dumps(obj, separators=(",", ":")).encode() + b"\n"
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(encode(obj))
+
+
+def read_msg(rfile) -> Optional[dict]:
+    """Read one JSON line from a socket makefile; None at EOF.  Raises
+    ``ValueError`` on a non-JSON or non-object line (the server turns
+    that into an error response rather than dying)."""
+    line = rfile.readline()
+    if not line:
+        return None
+    obj = json.loads(line)
+    if not isinstance(obj, dict):
+        raise ValueError(f"protocol message is not an object: {obj!r}")
+    return obj
+
+
+def read_exact(rfile, n: int) -> bytes:
+    """Read exactly ``n`` payload bytes (the FASTA body after a result
+    header); raises ``ConnectionError`` on a short read."""
+    parts = []
+    remaining = n
+    while remaining > 0:
+        chunk = rfile.read(remaining)
+        if not chunk:
+            raise ConnectionError(
+                f"connection closed {remaining} bytes short of the "
+                f"announced {n}-byte payload")
+        parts.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(parts)
+
+
+def normalize_spec(raw: dict) -> Tuple[Optional[dict], Optional[str]]:
+    """Validate + default-fill a submitted job spec.  Returns
+    ``(spec, None)`` or ``(None, reason)`` — a malformed spec is an
+    admission rejection, never a server fault."""
+    if not isinstance(raw, dict):
+        return None, f"job spec is not an object: {type(raw).__name__}"
+    unknown = set(raw) - set(SPEC_KEYS)
+    if unknown:
+        return None, f"unknown job spec keys: {sorted(unknown)}"
+    spec = {}
+    for key in SPEC_PATHS:
+        val = raw.get(key)
+        if not isinstance(val, str) or not val:
+            return None, f"job spec is missing input path {key!r}"
+        spec[key] = val
+    for key, default in SPEC_DEFAULTS.items():
+        val = raw.get(key, default)
+        if isinstance(default, bool):
+            if not isinstance(val, bool):
+                return None, f"job spec {key!r} must be a boolean"
+        elif isinstance(default, int):
+            if not isinstance(val, int) or isinstance(val, bool):
+                return None, f"job spec {key!r} must be an integer"
+        elif isinstance(default, float):
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                return None, f"job spec {key!r} must be a number"
+            val = float(val)
+        spec[key] = val
+    if spec["window_length"] <= 0:
+        return None, "job spec window_length must be positive"
+    if spec["threads"] < 1:
+        return None, "job spec threads must be >= 1"
+    return spec, None

@@ -1,0 +1,860 @@
+"""PolishServer: the long-lived polishing daemon (``racon --serve``).
+
+Every one-shot ``racon`` invocation pays the cold XLA compile
+(16–80 s at BENCH r04/r05) for kernels whose warm dispatch is
+sub-second — fatal for heavy traffic of small jobs (one user's plasmid
+or amplicon panel).  The reference amortizes exactly this cost by
+reusing its cudapoa/cudaaligner batch objects across fills (SURVEY
+§L3); this server is the TPU analog at process granularity: ONE
+resident process keeps a warm engine pool alive and executes submitted
+polish jobs through the existing :meth:`Polisher.run` pipeline with
+those engines injected, so a job's latency is compute, not compile.
+
+Architecture (every piece is an existing subsystem, re-hosted):
+
+- **Warm engine pool** — one :class:`racon_tpu.exec.runner._ChipWorker`
+  per local chip (the round-13 slot type; the server passes itself as
+  the duck-typed engine profile), each slot owning a device-pinned
+  aligner/consensus pair plus a CPU-retry pair.  Engines are built
+  eagerly at startup and *never* discarded: jit caches, SWAR probes and
+  warm-up compiles survive across every job the server ever runs, and
+  ``configure_compile_cache`` persists the executables across server
+  restarts.
+- **Shape canonicalization** — jobs land on already-compiled
+  executables because the ragged consensus stream buckets windows by
+  power-of-two lane width against a fixed arena (round 10): two jobs
+  with the same polishing parameters share executables regardless of
+  their input sizes.  At startup the pool warm-compiles the expected
+  profile (``RACON_TPU_SERVE_WARM_SHAPES``) so job #1 is already warm,
+  and every admitted job's own geometry is handed to ``warmup_async``
+  (shape-deduped) so a genuinely new geometry starts compiling while
+  the job waits in queue.
+- **Admission control** — the exec planner's resident-footprint cost
+  model (:func:`racon_tpu.exec.planner.estimate_job_cost`) gates
+  submissions: a job estimated over the budget, a full queue, or a
+  parameter set the resident engines cannot serve (the score/banding
+  profile is baked into the compiled kernels) is *rejected with the
+  reason* — never silently queued into an OOM.  Workers start a job
+  only while the summed estimate of running jobs fits the budget.
+- **Degradation ladder** — a failed job attempt walks the round-12
+  per-class ladder (transient-io backoff → device-OOM backpressure via
+  ``reduce_capacity`` → CPU engines → fail-with-reason); the server
+  survives every rung — a job dying must never take the warm pool (and
+  every queued job behind it) down with it.
+- **Per-job observability** — each job runs under its own metric scope
+  (``job.<id>.*``, :func:`racon_tpu.obs.metrics.set_scope`), gets its
+  own schema-validated ``run_report`` (kind ``"job"``) returned
+  alongside the result, and real XLA compile seconds are attributed
+  per job via a ``jax.monitoring`` duration listener — the
+  ``service_compile_fraction`` number the ROADMAP item is scored on.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .. import faults, flags
+from ..core.polisher import PolisherType, create_polisher
+from ..exec import heartbeat as hb
+from ..exec import lease as lease_mod
+from ..exec.planner import estimate_job_cost, input_cost_bytes, parse_ram
+from ..exec.runner import _ChipWorker
+from ..io import parsers
+from ..obs import metrics, report as obs_report
+from ..parallel.topology import ChipSlot
+from ..utils.logger import log_swallowed, warn
+from . import protocol
+
+# job states
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+_TERMINAL = (DONE, FAILED, CANCELLED)
+
+# default client-side wait bound for a blocking result request
+DEFAULT_RESULT_TIMEOUT_S = 3600.0
+
+
+def _eprint(msg: str) -> None:
+    print(f"[racon_tpu::serve] {msg}", file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------ compile attribution
+
+_monitor_armed = False
+_monitor_lock = threading.Lock()
+
+
+def arm_compile_monitor() -> bool:
+    """Attribute real XLA compile seconds to the thread that compiles:
+    a ``jax.monitoring`` duration listener accumulates every
+    ``/jax/core/compile/*`` event into the ``compile.jax_s`` timer —
+    which, fired on a job's worker thread, lands in THAT job's metric
+    scope.  This is the measured numerator of
+    ``service_compile_fraction``; warm-up compiles run on unscoped
+    background threads and are deliberately not charged to any job."""
+    global _monitor_armed
+    with _monitor_lock:
+        if _monitor_armed:
+            return True
+        try:
+            import jax.monitoring as jmon
+
+            def _on_duration(event, duration, **kwargs):
+                if event.startswith("/jax/core/compile/"):
+                    metrics.add_time("compile.jax_s", duration)
+
+            jmon.register_event_duration_secs_listener(_on_duration)
+            _monitor_armed = True
+        except Exception as e:
+            log_swallowed(
+                "serve: jax.monitoring compile listener unavailable "
+                "(per-job compile_s will read 0)", e)
+            return False
+    return True
+
+
+def parse_warm_shapes(raw: str) -> List[Tuple[int, int, int, int]]:
+    """Parse ``RACON_TPU_SERVE_WARM_SHAPES``: comma-separated
+    ``window_length:pairs:windows[:contigs]`` entries.  A malformed
+    entry fails loudly (an operator typo must not silently serve
+    cold)."""
+    out: List[Tuple[int, int, int, int]] = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"RACON_TPU_SERVE_WARM_SHAPES entry {entry!r} is not "
+                f"window_length:pairs:windows[:contigs]")
+        vals = [int(p) for p in parts]
+        if any(v <= 0 for v in vals):
+            raise ValueError(
+                f"RACON_TPU_SERVE_WARM_SHAPES entry {entry!r} has a "
+                f"non-positive field")
+        out.append((vals[0], vals[1], vals[2],
+                    vals[3] if len(vals) == 4 else 1))
+    return out
+
+
+class Job:
+    """One submitted polish job: spec, admission cost, lifecycle state,
+    ladder attempts, result payload and the per-job run report."""
+
+    def __init__(self, job_id: str, spec: dict, cost: int):
+        self.id = job_id
+        self.spec = spec
+        self.cost = cost
+        self.state = QUEUED
+        self.error: Optional[str] = None
+        self.engine: Optional[str] = None
+        self.attempts: List[dict] = []
+        self.result: Optional[bytes] = None
+        self.result_bytes = 0          # recorded before retention drop
+        self.collected = False
+        self.phases: Dict[str, float] = {}
+        self.report: Optional[dict] = None
+        self.worker: Optional[str] = None
+        self.submitted_unix = time.time()
+        self.started_at: Optional[float] = None
+        self.wall_s = 0.0
+        self.compile_s = 0.0
+        self.done = threading.Event()
+
+    def row(self) -> dict:
+        """The protocol's status view of this job."""
+        out = {"job": self.id, "state": self.state,
+               "cost_bytes": self.cost,
+               "submitted_unix": round(self.submitted_unix, 3)}
+        if self.worker:
+            out["worker"] = self.worker
+        if self.engine:
+            out["engine"] = self.engine
+        if self.attempts:
+            out["attempts"] = self.attempts
+        if self.state in _TERMINAL:
+            out["wall_s"] = round(self.wall_s, 3)
+            out["compile_s"] = round(self.compile_s, 3)
+            out["bytes"] = self.result_bytes
+        elif self.started_at is not None:
+            out["wall_s"] = round(time.perf_counter() - self.started_at,
+                                  3)
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class PolishServer:
+    """The resident polishing service (see the module docstring).
+
+    The server object doubles as the duck-typed **engine profile**
+    :class:`racon_tpu.exec.runner._ChipWorker` consumes — the
+    attributes below named like :class:`ShardRunner`'s are that
+    contract, and they are also the *service profile* admission checks
+    jobs against: scores and banding are baked into the resident
+    compiled kernels, so a job requesting different ones cannot be
+    served warm and is rejected with that reason."""
+
+    def __init__(self, socket_path: str, *,
+                 match: int = 3, mismatch: int = -5, gap: int = -4,
+                 banded: bool = False, num_threads: int = 1,
+                 aligner_backend: str = "auto",
+                 consensus_backend: str = "auto",
+                 aligner_batches: int = 1, consensus_batches: int = 1,
+                 chips: int = 0, workers: int = 0,
+                 budget_bytes: int = 0, max_queue: int = 0,
+                 autostart: bool = True):
+        self.socket_path = os.path.abspath(socket_path)
+        self.match, self.mismatch, self.gap = match, mismatch, gap
+        self.banded = banded
+        self.num_threads = num_threads
+        self.aligner_backend = aligner_backend
+        self.consensus_backend = consensus_backend
+        self.aligner_batches = aligner_batches
+        self.consensus_batches = consensus_batches
+        self.chips_requested = chips
+        self.workers_requested = workers
+        self.worker = lease_mod.worker_identity()
+        self.budget_bytes = budget_bytes or parse_ram(
+            flags.get_str("RACON_TPU_SERVE_BUDGET"))
+        self.max_queue = max_queue or max(
+            1, flags.get_int("RACON_TPU_SERVE_QUEUE"))
+        self.autostart = autostart
+
+        self._slots: Optional[List[_ChipWorker]] = None
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Job] = []            # admitted, not yet running
+        self._jobs: Dict[str, Job] = {}
+        # terminal jobs retained for status/result queries, oldest
+        # first; bounded so a server that has run 100k jobs holds 100k
+        # of nothing (payloads go after one fetch, scoped metrics at
+        # job end, and whole records past this horizon)
+        self._retired: List[str] = []
+        self.max_retained_jobs = 1024
+        self._next_id = 0
+        self._running_cost = 0
+        self._counts = {"submitted": 0, "rejected": 0, "done": 0,
+                        "failed": 0, "cancelled": 0}
+        self._stop = threading.Event()
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conn_threads: List[threading.Thread] = []
+        self._t0 = time.perf_counter()
+        self.started = threading.Event()       # listener bound + warm kick
+
+    # ------------------------------------------------------- engine pool
+
+    def _chip_slots(self) -> List[_ChipWorker]:
+        """The warm executor pool: one slot per local chip (mirrors the
+        shard runner's auto-engagement — explicit ``--chips`` /
+        ``RACON_TPU_CHIPS`` wins, else every local device when a device
+        backend runs on real multi-chip hardware), topped up to
+        ``workers`` unpinned slots when more concurrency than chips was
+        asked for (each slot owns its OWN engine pair — engines hold
+        per-run state and are never shared across concurrent jobs)."""
+        if self._slots is not None:
+            return self._slots
+        n = 1
+        explicit = self.chips_requested > 0 \
+            or flags.get_int("RACON_TPU_CHIPS") > 0
+        if explicit:
+            from ..parallel import topology
+            n = topology.resolve_chips(self.chips_requested)
+        elif "tpu" in (self.aligner_backend, self.consensus_backend):
+            from ..parallel import topology
+            devs = topology.local_devices()
+            if len(devs) > 1 and \
+                    getattr(devs[0], "platform", "cpu") != "cpu":
+                n = len(devs)
+        if n <= 1:
+            slots = [_ChipWorker(self, ChipSlot(0, None), pinned=False)]
+        else:
+            from ..parallel import topology
+            topo = topology.Topology(n)
+            slots = [_ChipWorker(self, s, pinned=True)
+                     for s in topo.slots]
+        for k in range(len(slots), max(1, self.workers_requested)):
+            extra = _ChipWorker(self, ChipSlot(k, None), pinned=False)
+            extra.worker = f"{self.worker}#w{k}"
+            slots.append(extra)
+        self._slots = slots
+        return slots
+
+    def _warm_pool(self) -> None:
+        """Build every slot's engines NOW (resident = the pool exists
+        before the first job) and kick the expected-shape warm-up
+        profile so job #1 dispatches into a hot jit cache."""
+        raw = flags.get_str("RACON_TPU_SERVE_WARM_SHAPES")
+        shapes = parse_warm_shapes(raw) if raw.strip() else []
+        for w in self._chip_slots():
+            aligner, consensus = w.get_engines(cpu=False)
+            warm = getattr(consensus, "warmup_async", None)
+            if warm is None:
+                continue
+            for (wl, pairs, wins, contigs) in shapes:
+                warm(wl, pairs, wins, est_contigs=contigs)
+        _eprint(f"engine pool: {len(self._chip_slots())} worker(s), "
+                f"budget {self.budget_bytes >> 20} MB, "
+                f"{len(shapes)} warm shape profile(s)")
+
+    def _warm_job_geometry(self, spec: dict) -> None:
+        """Hand an admitted job's own (estimated) geometry to every
+        slot's warm-up — shape-deduped in the engine, so a repeat
+        geometry (the service's common case) is free and a genuinely
+        new one starts compiling while the job waits in queue."""
+        wl = spec["window_length"]
+        read_bases = max(1, input_cost_bytes(spec["sequences"]) // 2)
+        target_bases = max(
+            1, input_cost_bytes(spec["target_sequences"]) // 2)
+        est_pairs = max(1, read_bases // wl)
+        est_windows = max(1, target_bases // wl)
+        for w in self._chip_slots():
+            if w.engines is None:
+                continue
+            warm = getattr(w.engines[1], "warmup_async", None)
+            if warm is not None:
+                warm(wl, est_pairs, est_windows,
+                     est_contigs=max(1, min(est_windows, 8)))
+
+    # --------------------------------------------------------- admission
+
+    def _admit(self, raw_spec: dict) -> Tuple[Optional[Job], Optional[str]]:
+        """Admission control: validate the spec, check it against the
+        resident engine profile, estimate its footprint with the exec
+        planner's cost model, and bound queue depth + total footprint.
+        Returns ``(job, None)`` or ``(None, rejection reason)`` — the
+        reject-with-reason contract that replaces a silent OOM."""
+        spec, err = protocol.normalize_spec(raw_spec)
+        if err is not None:
+            return None, err
+        for key in protocol.SPEC_PATHS:
+            spec[key] = os.path.abspath(spec[key])
+            if not os.path.isfile(spec[key]):
+                return None, f"input not found: {spec[key]}"
+        for path, kind in ((spec["sequences"], "sequences"),
+                           (spec["target_sequences"], "target")):
+            if parsers.sequence_parser_for(path) is None:
+                return None, (f"{kind} file {path} has an unsupported "
+                              f"format extension")
+        if parsers.overlap_parser_for(spec["overlaps"]) is None:
+            return None, (f"overlaps file {spec['overlaps']} has an "
+                          f"unsupported format extension")
+        profile = (self.match, self.mismatch, self.gap, self.banded)
+        requested = (spec["match"], spec["mismatch"], spec["gap"],
+                     spec["banded"])
+        if requested != profile:
+            return None, (
+                f"engine profile mismatch: the resident engines are "
+                f"compiled for (match, mismatch, gap, banded) = "
+                f"{profile}, the job asked for {requested} — submit to "
+                f"a server started with those scores, or restart this "
+                f"one with them")
+        cost = estimate_job_cost(spec["sequences"], spec["overlaps"],
+                                 spec["target_sequences"])
+        if cost > self.budget_bytes:
+            return None, (
+                f"job footprint estimate {cost >> 20} MB exceeds the "
+                f"service budget {self.budget_bytes >> 20} MB "
+                f"(--serve-budget / RACON_TPU_SERVE_BUDGET) — run it "
+                f"one-shot through the streaming shard runner "
+                f"(--max-ram) instead")
+        with self._cond:
+            if len(self._queue) >= self.max_queue:
+                return None, (
+                    f"queue full ({self.max_queue} jobs waiting; "
+                    f"RACON_TPU_SERVE_QUEUE raises the bound)")
+            self._next_id += 1
+            job = Job(f"j{self._next_id}", spec, cost)
+            self._jobs[job.id] = job
+            self._queue.append(job)
+            self._counts["submitted"] += 1
+            self._cond.notify_all()
+        # outside the lock: warm-up geometry derivation stats files
+        self._warm_job_geometry(spec)
+        return job, None
+
+    # ------------------------------------------------------ job execution
+
+    def _next_job(self, worker: _ChipWorker) -> Optional[Job]:
+        """Block until the HEAD of the queue fits the in-flight
+        footprint budget (or the server stops).  Strict FIFO: a big
+        job waiting for footprint is never overtaken by later small
+        ones — overtaking would keep the footprint pinned high and
+        starve it indefinitely.  Progress is guaranteed: admission
+        rejected anything bigger than the whole budget, so the head
+        always fits once enough running jobs drain (at the latest,
+        when the pool is idle)."""
+        with self._cond:
+            while True:
+                if self._stop.is_set():
+                    return None
+                if self._queue:
+                    job = self._queue[0]
+                    if job.cost + self._running_cost \
+                            <= self.budget_bytes \
+                            or self._running_cost == 0:
+                        self._queue.pop(0)
+                        job.state = RUNNING
+                        job.worker = worker.worker
+                        job.started_at = time.perf_counter()
+                        self._running_cost += job.cost
+                        return job
+                self._cond.wait(0.2)
+
+    def _worker_loop(self, worker: _ChipWorker) -> None:
+        while True:
+            job = self._next_job(worker)
+            if job is None:
+                return
+            try:
+                self._run_job(worker, job)
+            except Exception as e:
+                # a fault OUTSIDE the per-attempt ladder (a report-build
+                # bug, say) must fail the job, never the worker — the
+                # warm pool outliving every job is the whole service
+                job.state = FAILED
+                job.error = f"internal error: {type(e).__name__}: {e}"
+                warn(f"job {job.id} worker fault past the ladder: {e}")
+            finally:
+                with self._cond:
+                    self._running_cost -= job.cost
+                    self._counts[job.state] = \
+                        self._counts.get(job.state, 0) + 1
+                    self._retired.append(job.id)
+                    while len(self._retired) > self.max_retained_jobs:
+                        old = self._jobs.pop(self._retired.pop(0),
+                                             None)
+                        if old is not None:
+                            old.result = None  # drop a never-fetched blob
+                    self._cond.notify_all()
+                job.done.set()
+            _eprint(f"job {job.id} {job.state} in {job.wall_s:.2f}s "
+                    f"(engine={job.engine or '-'}, "
+                    f"compile {job.compile_s:.2f}s, "
+                    f"{job.result_bytes} B) on {worker.worker}")
+
+    def _polish(self, job: Job, worker: _ChipWorker,
+                cpu: bool) -> bytes:
+        """One polish attempt with the worker's resident engines
+        injected — the job's whole latency is :meth:`Polisher.run`."""
+        spec = job.spec
+        aligner, consensus = worker.get_engines(cpu)
+        p = create_polisher(
+            spec["sequences"], spec["overlaps"],
+            spec["target_sequences"],
+            PolisherType.F if spec["fragment_correction"]
+            else PolisherType.C,
+            window_length=spec["window_length"],
+            quality_threshold=spec["quality_threshold"],
+            error_threshold=spec["error_threshold"],
+            trim=not spec["no_trimming"],
+            match=spec["match"], mismatch=spec["mismatch"],
+            gap=spec["gap"], num_threads=spec["threads"],
+            aligner=aligner, consensus=consensus)
+        polished = p.run(not spec["include_unpolished"])
+        job.phases = dict(p.timings)
+        return b"".join(b">" + s.name + b"\n" + s.data + b"\n"
+                        for s in polished)
+
+    def _run_job(self, worker: _ChipWorker, job: Job) -> None:
+        """Execute one job under its own metric scope, walking the
+        round-12 degradation ladder on failure — the server survives
+        every rung, and the ladder record rides in the job's status,
+        result and report."""
+        scope = metrics.job_scope(job.id)
+        metrics.set_scope(scope)
+        t_start = time.time()
+        t0 = time.perf_counter()
+        max_retries = max(0, flags.get_int("RACON_TPU_EXEC_RETRIES"))
+        transient_used = 0
+        tier_cpu = False
+        blob: Optional[bytes] = None
+        try:
+            for attempt_no in range(64):  # ladder is finite
+                try:
+                    faults.check("serve.polish", attempt=attempt_no)
+                    blob = self._polish(job, worker, cpu=tier_cpu)
+                    break
+                except Exception as e:
+                    cls = faults.classify(e)
+                    metrics.inc(f"faults.{cls}")
+                    err = f"{type(e).__name__}: {e}"
+                    att = {"n": attempt_no,
+                           "engine": "cpu" if tier_cpu else "primary",
+                           "class": cls, "error": err}
+                    job.attempts.append(att)
+                    if cls == faults.CLASS_TRANSIENT and \
+                            transient_used < max_retries:
+                        backoff = (max(0.0, flags.get_float(
+                            "RACON_TPU_EXEC_BACKOFF_S"))
+                            * (2.0 ** transient_used))
+                        att["action"] = "retry-backoff"
+                        att["backoff_s"] = round(backoff, 3)
+                        transient_used += 1
+                        warn(f"job {job.id} transient fault ({err}) — "
+                             f"retry {transient_used}/{max_retries} in "
+                             f"{backoff:.2f}s")
+                        time.sleep(backoff)
+                    elif cls == faults.CLASS_OOM and not tier_cpu and \
+                            worker.reduce_capacity():
+                        att["action"] = "reduce-capacity"
+                        warn(f"job {job.id} device OOM ({err}) — "
+                             f"halved worker {worker.worker}'s "
+                             f"consensus arena/group capacity, "
+                             f"re-dispatching on the device")
+                    elif not tier_cpu:
+                        tier_cpu = True
+                        att["action"] = "cpu-retry"
+                        warn(f"job {job.id} attempt failed ({err}) — "
+                             f"retrying on the CPU engines")
+                    else:
+                        att["action"] = "fail"
+                        job.error = "; ".join(
+                            a["error"] for a in job.attempts)
+                        break
+            job.wall_s = time.perf_counter() - t0
+            job.compile_s = metrics.timer_s(scope + "compile.jax_s")
+            if blob is not None:
+                job.result = blob
+                job.result_bytes = len(blob)
+                job.engine = "cpu-retry" if tier_cpu else "primary"
+                job.state = DONE
+            else:
+                job.state = FAILED
+            # the per-job run report: built from THIS job's metric
+            # scope, so concurrent jobs' numbers stay disjoint — the
+            # machine-readable artifact returned alongside the result
+            job.report = obs_report.build_report(
+                "job", argv=[job.id, spec_summary(job.spec)],
+                started_unix=t_start, wall_s=job.wall_s,
+                phases=job.phases, scope=scope)
+        finally:
+            metrics.set_scope(None)
+            # the report snapshot above embeds everything the scope
+            # held; retiring the registry entries NOW is what keeps a
+            # server that runs 100k jobs from growing the metrics
+            # dicts without bound (the heartbeat only reads RUNNING
+            # jobs' scopes, so nothing still wants these)
+            metrics.clear_job(job.id)
+
+    # ----------------------------------------------------------- protocol
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        try:
+            while True:
+                try:
+                    msg = protocol.read_msg(rfile)
+                except ValueError as e:
+                    protocol.send_msg(conn, {"ok": False,
+                                             "error": f"bad request: {e}"})
+                    return
+                if msg is None:
+                    return
+                try:
+                    if not self._dispatch_op(conn, msg):
+                        return
+                except (ValueError, TypeError, KeyError) as e:
+                    # a malformed FIELD (non-numeric timeout_s, an
+                    # unhashable job id) is the client's fault: answer
+                    # with the reason instead of letting the handler
+                    # thread die and the socket close silently
+                    protocol.send_msg(conn, {
+                        "ok": False,
+                        "error": f"bad request field: "
+                                 f"{type(e).__name__}: {e}"})
+        except OSError as e:
+            # a client hanging up mid-response is its own business —
+            # the server's job records stay intact either way
+            log_swallowed("serve: client connection dropped", e)
+        finally:
+            rfile.close()
+            conn.close()
+
+    def _dispatch_op(self, conn, msg: dict) -> bool:
+        """Handle one request; False ends the connection loop."""
+        op = msg.get("op")
+        if op == "ping":
+            protocol.send_msg(conn, {
+                "ok": True, "server": self.worker,
+                "uptime_s": round(time.perf_counter() - self._t0, 3),
+                "profile": {"match": self.match,
+                            "mismatch": self.mismatch, "gap": self.gap,
+                            "banded": self.banded},
+                "workers": len(self._chip_slots())})
+            return True
+        if op == "submit":
+            job, reason = self._admit(msg.get("spec", {}))
+            if job is None:
+                with self._lock:
+                    self._counts["rejected"] += 1
+                protocol.send_msg(conn, {"ok": False, "error": reason,
+                                         "rejected": True})
+                return True
+            protocol.send_msg(conn, {"ok": True, "job": job.id,
+                                     "state": job.state,
+                                     "cost_bytes": job.cost})
+            return True
+        if op in ("status", "result", "cancel"):
+            job = self._jobs.get(msg.get("job", ""))
+            if job is None:
+                protocol.send_msg(conn, {
+                    "ok": False,
+                    "error": f"unknown job {msg.get('job')!r}"})
+                return True
+            if op == "status":
+                row = job.row()
+                with self._lock:
+                    if job in self._queue:
+                        row["queue_position"] = self._queue.index(job)
+                protocol.send_msg(conn, {"ok": True, **row})
+                return True
+            if op == "cancel":
+                return self._op_cancel(conn, job)
+            return self._op_result(conn, job, msg)
+        if op == "stats":
+            with self._lock:
+                counts = dict(self._counts)
+                depth = len(self._queue)
+                running = self._running_cost
+            protocol.send_msg(conn, {
+                "ok": True, **counts, "queued": depth,
+                "running_cost_bytes": running,
+                "budget_bytes": self.budget_bytes,
+                "peak_rss_bytes": metrics.peak_rss_bytes()})
+            return True
+        if op == "shutdown":
+            protocol.send_msg(conn, {"ok": True, "state": "stopping"})
+            self.shutdown()
+            return False
+        protocol.send_msg(conn, {"ok": False,
+                                 "error": f"unknown op {op!r}"})
+        return True
+
+    def _op_cancel(self, conn, job: Job) -> bool:
+        with self._cond:
+            if job in self._queue:
+                self._queue.remove(job)
+                job.state = CANCELLED
+                job.error = "cancelled by client"
+                self._counts["cancelled"] += 1
+                self._retired.append(job.id)  # bounded-history horizon
+                job.done.set()
+                protocol.send_msg(conn, {"ok": True, "job": job.id,
+                                         "state": job.state})
+                return True
+        protocol.send_msg(conn, {
+            "ok": False, "job": job.id, "state": job.state,
+            "error": f"job {job.id} is not queued ({job.state}) — a "
+                     f"running job cannot be safely interrupted "
+                     f"mid-dispatch"})
+        return True
+
+    def _op_result(self, conn, job: Job, msg: dict) -> bool:
+        timeout = float(msg.get("timeout_s", DEFAULT_RESULT_TIMEOUT_S))
+        if not job.done.wait(timeout):
+            protocol.send_msg(conn, {
+                "ok": False, "job": job.id, "state": job.state,
+                "timeout": True,
+                "error": f"job {job.id} not finished within "
+                         f"{timeout:.0f}s (still {job.state})"})
+            return True
+        header = {"ok": job.state == DONE, **job.row(),
+                  "report": job.report}
+        if job.state != DONE:
+            protocol.send_msg(conn, header)
+            return True
+        with self._lock:
+            blob = job.result
+        if blob is None:
+            why = ("was already collected (payloads are retained for "
+                   "one successful fetch)" if job.collected
+                   else "was retired (the server keeps a bounded "
+                        "terminal-job history)")
+            header.update(ok=False,
+                          error=f"job {job.id} result {why}")
+            protocol.send_msg(conn, header)
+            return True
+        header["bytes"] = len(blob)
+        protocol.send_msg(conn, header)
+        conn.sendall(blob)
+        if not msg.get("keep", False):
+            # retention: the FASTA payload is the big allocation — one
+            # SUCCESSFUL fetch per job keeps a long-lived server's
+            # memory bounded by in-flight work, not by its history.
+            # Dropped only AFTER sendall returned: a client that died
+            # waiting must be able to reconnect and fetch (two racing
+            # fetchers both succeed; the second drop is a no-op).
+            with self._lock:
+                job.result = None
+                job.collected = True
+        return True
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        """Per-job progress heartbeat: one line per tick naming every
+        running job with its scope's pack/queue/retrace summaries —
+        the shard heartbeat's fields, re-keyed per job."""
+        while not self._stop.wait(interval):
+            with self._lock:
+                running = [j for j in self._jobs.values()
+                           if j.state == RUNNING]
+                depth = len(self._queue)
+                counts = dict(self._counts)
+            fields = []
+            for j in running:
+                scope = metrics.job_scope(j.id)
+                dt = (time.perf_counter() - j.started_at
+                      if j.started_at else 0.0)
+                fields.append(
+                    f"{j.id}@{hb.Heartbeat._short(j.worker or '?')}"
+                    f" {dt:.1f}s pack[{hb.pack_summary_str(scope)}]"
+                    f" queue[{hb.queue_summary_str(scope)}]"
+                    f" retrace[{hb.retrace_summary(scope)}]")
+            _eprint(f"heartbeat: {counts.get('done', 0)} done, "
+                    f"{counts.get('failed', 0)} failed, "
+                    f"{len(running)} running"
+                    + (" (" + "; ".join(fields) + ")" if fields else "")
+                    + f", {depth} queued, "
+                    f"peak_rss={metrics.peak_rss_bytes() >> 20}MB")
+
+    def start_workers(self) -> None:
+        """Spawn the pool's worker threads (idempotent; split out so
+        tests can exercise the queue deterministically before any
+        worker drains it)."""
+        if self._threads:
+            return
+        for w in self._chip_slots():
+            t = threading.Thread(target=self._worker_loop, args=(w,),
+                                 name=f"racon-serve-{w.worker}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _bind(self) -> socket.socket:
+        path = self.socket_path
+        if os.path.exists(path):
+            import stat as stat_mod
+            if not stat_mod.S_ISSOCK(os.stat(path).st_mode):
+                # refuse, don't unlink: a typo'd --serve path must not
+                # delete the operator's regular file
+                raise RuntimeError(
+                    f"{path} exists and is not a socket — refusing to "
+                    f"replace it")
+            # a previous server may have died without unlinking; only a
+            # CONNECTABLE socket proves a live one
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(1.0)
+            try:
+                probe.connect(path)
+            except OSError as e:
+                log_swallowed("serve: removing stale socket file", e)
+                os.unlink(path)
+            else:
+                raise RuntimeError(
+                    f"another server is already listening on {path}")
+            finally:
+                probe.close()
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(path)
+        listener.listen(64)
+        return listener
+
+    def serve_forever(self) -> int:
+        """Bind, warm the pool, accept until :meth:`shutdown`.  Returns
+        an exit code (0 on a clean stop)."""
+        arm_compile_monitor()
+        # span TIMERS must record for the life of the server: the
+        # per-job dispatch/fetch split reads them through each job's
+        # metric scope (ring-buffer tracing stays off — a long-lived
+        # daemon's trace is unbounded by definition)
+        from ..obs import trace
+        trace.activate()
+        self._listener = self._bind()
+        self._warm_pool()
+        if self.autostart:
+            self.start_workers()
+        interval = flags.get_float("RACON_TPU_HEARTBEAT_S")
+        if interval > 0:
+            t = threading.Thread(target=self._heartbeat_loop,
+                                 args=(interval,),
+                                 name="racon-serve-heartbeat",
+                                 daemon=True)
+            t.start()
+        _eprint(f"listening on {self.socket_path} "
+                f"(server {self.worker})")
+        self.started.set()
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._listener.accept()
+                except OSError:
+                    break  # listener closed by shutdown()
+                t = threading.Thread(target=self._handle_conn,
+                                     args=(conn,), daemon=True)
+                t.start()
+                self._conn_threads.append(t)
+                self._conn_threads = [c for c in self._conn_threads
+                                      if c.is_alive()]
+        finally:
+            self.shutdown()
+            for t in self._threads:
+                t.join()
+        _eprint(f"stopped ({self._counts['done']} done, "
+                f"{self._counts['failed']} failed, "
+                f"{self._counts['rejected']} rejected)")
+        return 0
+
+    def shutdown(self) -> None:
+        """Stop accepting, let running jobs finish, fail what is still
+        queued (idempotent)."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        with self._cond:
+            for job in self._queue:
+                job.state = FAILED
+                job.error = "server shutdown before the job ran"
+                job.done.set()
+            self._queue.clear()
+            self._cond.notify_all()
+        if self._listener is not None:
+            try:
+                # shutdown() BEFORE close(): a close alone does not
+                # reliably wake a thread blocked in accept() on Linux —
+                # the accept loop would outlive the server
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError as e:
+                log_swallowed("serve: listener shutdown failed", e)
+            try:
+                self._listener.close()
+            except OSError as e:
+                log_swallowed("serve: listener close failed", e)
+        try:
+            os.unlink(self.socket_path)
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            log_swallowed("serve: socket unlink failed", e)
+
+
+def spec_summary(spec: dict) -> str:
+    """One-line human summary of a job spec (report argv, logs)."""
+    return (f"{os.path.basename(spec['sequences'])} "
+            f"{os.path.basename(spec['overlaps'])} "
+            f"{os.path.basename(spec['target_sequences'])} "
+            f"-w {spec['window_length']} -t {spec['threads']}"
+            + (" -f" if spec["fragment_correction"] else "")
+            + (" -u" if spec["include_unpolished"] else ""))
