@@ -6,12 +6,21 @@
 # byte-diff.
 set -e
 cd "$(dirname "$0")/../.."
-# graftlint shard first (fail-fast, cheapest): the linter's own
-# fixture-based self-tests, then the repo-wide run — zero unsuppressed
-# findings is a hard gate (tracer leaks, unguarded SWAR entry points,
-# swallowed exceptions, rogue env flags, host syncs in hot loops)
+# ONE consolidated graftlint gate (fail-fast, cheapest): the linter's
+# fixture-based self-tests, then a single repo-wide run with all 11
+# rules — tracer leaks, unguarded SWAR entry points, swallowed
+# exceptions, rogue env flags, host syncs, span discipline, and the
+# round-15 concurrency/durability pack (lock-discipline,
+# blocking-under-lock, atomic-write-discipline, thread-lifecycle,
+# scope-discipline). Zero unsuppressed findings is a hard gate; this
+# replaces the five former per-shard `tools.analysis <subdir>` runs —
+# the project indexes (call graph, contexts, blocking closure) build
+# once instead of six times. Wall time is recorded so the gate's cost
+# stays visible (budget: < 30 s on this repo).
+lint_t0=$SECONDS
 python -m tools.analysis --selftest
 python -m tools.analysis --quiet racon_tpu tests tools bench.py
+echo "graftlint gate (selftest + repo-wide, 11 rules): $((SECONDS - lint_t0))s (budget 30s)"
 # the README env-flags table is generated from racon_tpu/flags.py and
 # must not drift
 python -m racon_tpu.flags --check-readme README.md
@@ -27,61 +36,49 @@ RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
 # decoder, and the pipelined run() — including the num_threads=1
 # sequential-fallback smoke — before anything slow runs
 python -m pytest tests/test_columnar_init.py tests/test_window.py -q
-# ragged-packing shard (fail-fast, round 10): graftlint gate over the
-# columnar layer store + ragged packer + matmul vote code, then the
-# {padded,ragged} x {scatter,matmul} byte-identity grid — and the same
-# grid again under the runtime sanitizer, so the int32 shadow path
-# proves itself on the packed ragged layout
-# (pallas_nw.py rides along so the interprocedural pass can resolve
-# poa.py's _note_pallas_failure logging sink, like the repo-wide run)
-python -m tools.analysis --quiet racon_tpu/core/layers.py \
-  racon_tpu/core/window.py racon_tpu/ops/poa.py \
-  racon_tpu/ops/pallas_nw.py tests/test_ragged.py
+# ragged-packing shard (fail-fast, round 10): the {padded,ragged} x
+# {scatter,matmul} byte-identity grid — and the same grid again under
+# the runtime sanitizer, so the int32 shadow path proves itself on the
+# packed ragged layout (lint coverage now rides in the consolidated
+# top-of-file gate)
 python -m pytest tests/test_ragged.py -q
 RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
   python -m pytest tests/test_ragged.py -q
-# streaming shard-run smoke (fail-fast): graftlint-clean gate over the
-# new racon_tpu/exec package, then the invariance suite — including the
-# 2-shard/3-shard byte-identity checks and the SIGKILL-then---resume
-# round trip — before anything slow runs
-python -m tools.analysis --quiet racon_tpu/exec
+# streaming shard-run smoke (fail-fast): the invariance suite —
+# including the 2-shard/3-shard byte-identity checks and the
+# SIGKILL-then---resume round trip — before anything slow runs
 python -m pytest tests/test_exec.py -q
-# fault-tolerance shard (fail-fast, round 12): graftlint gate over the
-# fault registry + lease protocol + ladder runner, then the suite —
-# lease claim/expiry/reclaim races, per-class ladder transitions
-# (backoff / OOM-backpressure re-dispatch parity / stall escalation /
+# fault-tolerance shard (fail-fast, round 12): lease claim/expiry/
+# reclaim races, per-class ladder transitions (backoff /
+# OOM-backpressure re-dispatch parity / stall escalation /
 # quarantine), part CRC verification + re-queue, run-report faults
 # schema, and the 2-worker chaos soak (seeded SIGKILL + injected
 # faults, byte-identical merge)
-python -m tools.analysis --quiet racon_tpu/faults.py racon_tpu/exec \
-  racon_tpu/sanitize.py racon_tpu/io/parsers.py tests/test_faults.py
 python -m pytest tests/test_faults.py -q
-# multi-chip execution shard (fail-fast, round 13): graftlint gate over
-# the parallel package + exec runner, then the topology/planner/chip-
-# scheduler suite — get_mesh prefix selection, distributed_init
-# idempotence, device-aware planning (LPT over chips + mesh marking),
-# the 8-fake-device single-invocation byte-identity run with per-device
-# report rows, the persistent-compile-cache round trip and the ragged
-# stream-geometry warm-up — plus the existing mesh parity suite
-python -m tools.analysis --quiet racon_tpu/parallel racon_tpu/exec \
-  tests/test_topology.py
+# concurrency shard (round 15): the exec/serve chaos soaks re-run with
+# the sanitizer armed — the named locks become WitnessedLocks, the
+# lock-order witness records the acquisition graph across every chip-
+# worker/lease-keeper/socket-handler thread (and the soaks' SIGKILLed
+# subprocesses), and any cycle reports at exit
+RACON_TPU_SANITIZE=1 python -m pytest tests/test_faults.py \
+  tests/test_serve.py -q -k "chaos or racing or concurrent"
+# multi-chip execution shard (fail-fast, round 13): the topology/
+# planner/chip-scheduler suite — get_mesh prefix selection,
+# distributed_init idempotence, device-aware planning (LPT over chips
+# + mesh marking), the 8-fake-device single-invocation byte-identity
+# run with per-device report rows, the persistent-compile-cache round
+# trip and the ragged stream-geometry warm-up — plus the existing mesh
+# parity suite
 python -m pytest tests/test_topology.py tests/test_parallel.py -q
-# resident-service shard (fail-fast, round 14): graftlint gate over the
-# serve package, then the service suite — protocol round-trip, three
-# concurrent jobs byte-identical to their one-shot CLI runs, admission
-# rejects-with-reason, the per-job fault ladder with server survival,
-# job-scoped metrics disjointness (the clear_run fix) and the warm-path
-# compile-amortization claim on the device engine
-python -m tools.analysis --quiet racon_tpu/serve racon_tpu/obs \
-  tests/test_serve.py
+# resident-service shard (fail-fast, round 14): protocol round-trip,
+# three concurrent jobs byte-identical to their one-shot CLI runs,
+# admission rejects-with-reason, the per-job fault ladder with server
+# survival, job-scoped metrics disjointness (the clear_run fix) and
+# the warm-path compile-amortization claim on the device engine
 python -m pytest tests/test_serve.py -q
-# observability shard (fail-fast, round 11): graftlint gate over the
-# obs package and every span-instrumented producer (span-discipline +
-# the 5 older rules), then the tracer/registry/report suite — trace
-# schema, RACON_TPU_TRACE byte-identity, disabled-span overhead guard,
+# observability shard (fail-fast, round 11): trace schema,
+# RACON_TPU_TRACE byte-identity, disabled-span overhead guard,
 # run-report schema validation for CLI and exec runs
-python -m tools.analysis --quiet racon_tpu/obs racon_tpu/core \
-  racon_tpu/exec racon_tpu/utils racon_tpu/cli.py racon_tpu/sanitize.py
 python -m pytest tests/test_obs.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \

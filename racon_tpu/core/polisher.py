@@ -319,6 +319,12 @@ class Polisher:
                     seq.transmute(has_name[i], has_data[i],
                                   has_reverse[i])
 
+        # builder-path writes (here through _assemble_layers) run on
+        # EITHER the main thread (initialize()/polish()) OR run()'s
+        # single producer thread — never both: exactly one builder runs
+        # per polisher, and the queue sentinel orders its last write
+        # before the consumer continues
+        # graftlint: disable=lock-discipline (one builder thread per polisher; paths are alternatives, ordered by the queue sentinel)
         self.timings["parse_s"] = round(time.perf_counter() - t_parse, 3)
 
         self.find_overlap_breaking_points(overlaps)
@@ -468,7 +474,9 @@ class Polisher:
                 win_lens.append(length)
                 k += 1
             id_to_first[i + 1] = id_to_first[i] + k
+        # graftlint: disable=lock-discipline (one builder thread per polisher; see _initialize_core)
         self._id_to_first_window = id_to_first
+        # graftlint: disable=lock-discipline (one builder thread per polisher; see _initialize_core)
         self._window_lengths = np.asarray(win_lens, dtype=np.int64)
 
     def _assemble_layers(self, overlaps: List[Overlap], emit=None,
@@ -490,6 +498,7 @@ class Polisher:
         n_ov = len(overlaps)
         n_win = len(self.windows)
         t_ids = np.fromiter((o.t_id for o in overlaps), np.int64, n_ov)
+        # graftlint: disable=lock-discipline (one builder thread per polisher; see _initialize_core)
         self.targets_coverages = np.bincount(
             t_ids, minlength=self.targets_size).tolist()
 

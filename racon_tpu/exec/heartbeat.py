@@ -26,7 +26,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import flags
+from .. import flags, sanitize
 from ..obs import metrics
 from ..obs.metrics import peak_rss_bytes  # noqa: F401  (re-export: the
 #   canonical implementation moved into the obs registry module; bench,
@@ -74,7 +74,7 @@ class Heartbeat:
         self.worker = worker
         self._stream = stream if stream is not None else sys.stderr
         self._t0 = time.perf_counter()
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("exec.heartbeat")
         self._done = 0
         self._mbp = 0.0
         # per-worker Mbp accumulators (round 13): concurrent in-process

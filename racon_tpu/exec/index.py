@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import sanitize
 from ..core.polisher import PolisherType
 from ..core.window import WindowType
 from ..io import parsers
@@ -70,21 +71,28 @@ class RunIndex:
     ov_read: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
     _groups: Optional[dict] = field(default=None, repr=False, compare=False)
 
+    def __post_init__(self):
+        # concurrent chip workers extract shards from ONE index: the
+        # lazy group build must happen once, not once per drain thread
+        # (the whole-run argsort is the expensive part)
+        self._groups_lock = sanitize.named_lock("exec.index")
+
     def _contig_groups(self) -> dict:
         """contig index -> kept-overlap index array (file order inside
         each group). ONE stable argsort for the whole run — per-contig
         masks would be O(n_contigs * n_overlaps), quadratic at the
         genome scale this subsystem targets (-f mode makes every read a
         target, pushing n_contigs into the millions)."""
-        if self._groups is None:
-            order = np.argsort(self.ov_target, kind="stable")
-            st = self.ov_target[order]
-            starts = np.flatnonzero(np.r_[True, np.diff(st) != 0]) \
-                if st.size else np.zeros(0, np.int64)
-            bounds = list(starts) + [st.size]
-            self._groups = {int(st[a]): order[a:b]
-                            for a, b in zip(bounds, bounds[1:])}
-        return self._groups
+        with self._groups_lock:
+            if self._groups is None:
+                order = np.argsort(self.ov_target, kind="stable")
+                st = self.ov_target[order]
+                starts = np.flatnonzero(np.r_[True, np.diff(st) != 0]) \
+                    if st.size else np.zeros(0, np.int64)
+                bounds = list(starts) + [st.size]
+                self._groups = {int(st[a]): order[a:b]
+                                for a, b in zip(bounds, bounds[1:])}
+            return self._groups
 
     def lines_of_contig(self, t_idx: int) -> np.ndarray:
         """Kept-overlap indices of one contig, in file order."""

@@ -65,7 +65,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import faults, flags, obs
+from .. import faults, flags, obs, sanitize
 from ..core.backends import make_aligner, make_consensus
 from ..core.polisher import PolisherType, create_polisher
 from ..io import parsers
@@ -133,9 +133,15 @@ class _ChipWorker:
         self.mesh_engines = None
 
     def get_engines(self, cpu: bool, mesh: bool = False):
+        # the engine caches below are deliberately lock-free: a slot is
+        # drained by exactly one worker thread for its whole life (the
+        # drain loop passes `worker=self`), and the serve pool builds
+        # every slot's engines in _warm_pool BEFORE start_workers()
+        # spawns a consumer — Thread.start() is the happens-before edge
         r = self.profile
         if cpu:
             if self.cpu_engines is None:
+                # graftlint: disable=lock-discipline (one drain thread per slot; serve warms before workers start)
                 self.cpu_engines = (
                     make_aligner("auto", r.num_threads),
                     make_consensus("auto", r.match, r.mismatch, r.gap,
@@ -152,6 +158,7 @@ class _ChipWorker:
                 # six excluded chips' HBM (nor inflate its own curve)
                 mesh_obj = get_mesh(devices=[
                     w.device for w in r._chip_slots()])
+                # graftlint: disable=lock-discipline (one drain thread per slot; serve warms before workers start)
                 self.mesh_engines = (
                     make_aligner(r.aligner_backend, r.num_threads,
                                  num_batches=r.aligner_batches,
@@ -162,6 +169,7 @@ class _ChipWorker:
                                    banded=r.banded, mesh=mesh_obj))
             return self.mesh_engines
         if self.engines is None:
+            # graftlint: disable=lock-discipline (one drain thread per slot; serve warms before workers start)
             self.engines = (
                 make_aligner(r.aligner_backend, r.num_threads,
                              num_batches=r.aligner_batches,
@@ -257,9 +265,12 @@ class ShardRunner:
         self._announced: set = set()
         self._beat = None          # heartbeat (owns Mbp attribution)
         # shared-manifest discipline for concurrent chip workers: entry
-        # mutations and snapshot serialization must not interleave
-        self._mf_lock = threading.Lock()
-        self._note_lock = threading.Lock()
+        # mutations and snapshot serialization must not interleave.
+        # named_lock: under RACON_TPU_SANITIZE=1 these feed the
+        # lock-order witness (cycle = potential deadlock, reported at
+        # process exit)
+        self._mf_lock = sanitize.named_lock("exec.manifest")
+        self._note_lock = sanitize.named_lock("exec.notes")
         # chip-pool unwind: any worker thread dying sets this so the
         # siblings stop polling (a dead primary's pending mesh shard
         # would otherwise never turn terminal and the pool would hang)
@@ -267,7 +278,7 @@ class ShardRunner:
         # shared state-file scan (multi-slot runs): N idle workers
         # re-reading the whole state directory every poll tick would
         # multiply the shared-FS metadata I/O round 12 bounded
-        self._states_lock = threading.Lock()
+        self._states_lock = sanitize.named_lock("exec.states")
         self._states_cache: Tuple[float, Dict[int, dict]] = (-1e9, {})
 
     # ------------------------------------------------------------ identity
@@ -339,6 +350,11 @@ class ShardRunner:
                 # 1-chip point of a scaling curve actually one chip
                 from ..parallel import topology
                 devs = topology.local_devices()
+                # resolved on the main path (run() sizes the plan by
+                # len(_chip_slots()) BEFORE _drain spawns any worker),
+                # so the thread-time calls below only ever hit the
+                # resolved fast path
+                # graftlint: disable=lock-discipline (resolved on the main path before worker threads spawn)
                 self._slots = [_ChipWorker(
                     self, ChipSlot(0, devs[0] if devs else None),
                     pinned=bool(devs))]
@@ -636,7 +652,15 @@ class ShardRunner:
         per write would be O(shards^2) metadata I/O on the shared
         filesystems multi-worker runs target."""
         with self._mf_lock:
+            # fsync-under-lock is the POINT of this lock: the snapshot
+            # serializes `manifest` while sibling chip workers mutate
+            # entries in place (dumps during mutation raises), and
+            # interleaved state/snapshot writes would invert the
+            # state-then-snapshot crash ordering. Hold time is one
+            # small JSON per shard transition.
+            # graftlint: disable=blocking-under-lock (the lock exists to serialize these durable writes against entry mutation)
             mf.save_shard_state(self.work_dir, entry)
+            # graftlint: disable=blocking-under-lock (same serialization: snapshot must not interleave with state writes)
             mf.save_manifest(self.work_dir, manifest)
 
     def _save_owned(self, entry: dict, manifest: dict, claim) -> None:
@@ -1130,7 +1154,12 @@ class ShardRunner:
         worker-unique tmp name) and return its (byte size, CRC32) for
         the manifest record the merge verifies against."""
         faults.check("part.write")
-        tmp = f"{part}.tmp.{os.getpid()}"
+        # pid alone is NOT unique here: after an in-process lease break
+        # (chip A stalls, chip B reclaims the shard) two slot threads of
+        # one process can be in _write_part for the same part — the ns
+        # suffix keeps their tmp files from tearing each other, exactly
+        # like manifest.atomic_write's
+        tmp = f"{part}.tmp.{os.getpid()}.{time.monotonic_ns()}"
         crc = 0
         size = 0
         with open(tmp, "wb") as f:
@@ -1193,10 +1222,17 @@ class ShardRunner:
         os.makedirs(d, exist_ok=True)
         idx = self.index
 
+        # the three shard-input files below are raw (no fsync/rename):
+        # they are RE-DERIVABLE scratch — extraction is deterministic
+        # byte ranges of the original inputs, each attempt rewrites the
+        # files from offset 0 before the polish that reads them, and a
+        # crash mid-extract just re-extracts on the retry/reclaim.
+        # Durable artifacts (parts, states, manifest, report) all go
+        # through the tmp+fsync+rename protocol.
         t_ext = _plain_ext(self.target_sequences,
                            parsers.SEQUENCE_EXTENSIONS, ".fasta")
         tgt_path = os.path.join(d, "targets" + t_ext)
-        with open(tgt_path, "wb") as f:
+        with open(tgt_path, "wb") as f:  # graftlint: disable=atomic-write-discipline (re-derivable scratch: deterministic re-extract on any retry)
             parsers.copy_byte_ranges(
                 self.target_sequences,
                 [(idx.targets[ci].start, idx.targets[ci].end)
@@ -1212,7 +1248,7 @@ class ShardRunner:
         r_ext = _plain_ext(self.sequences, parsers.SEQUENCE_EXTENSIONS,
                            ".fasta")
         reads_path = os.path.join(d, "reads" + r_ext)
-        with open(reads_path, "wb") as f:
+        with open(reads_path, "wb") as f:  # graftlint: disable=atomic-write-discipline (re-derivable scratch: deterministic re-extract on any retry)
             parsers.copy_byte_ranges(
                 self.sequences,
                 [(int(idx.read_spans[r, 0]), int(idx.read_spans[r, 1]))
@@ -1221,7 +1257,7 @@ class ShardRunner:
         ovl_path = os.path.join(d, "overlaps." + idx.overlap_fmt)
         ranges = [(int(idx.ov_start[i]), int(idx.ov_end[i]))
                   for i in line_ids]
-        with open(ovl_path, "wb") as f:
+        with open(ovl_path, "wb") as f:  # graftlint: disable=atomic-write-discipline (re-derivable scratch: deterministic re-extract on any retry)
             if idx.overlap_fmt == "mhap":
                 # MHAP addresses records by file ordinal: rewrite the two
                 # id columns to the shard-local 1-based positions

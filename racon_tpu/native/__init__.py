@@ -76,6 +76,9 @@ def build(force: bool = False) -> pathlib.Path:
                 *[str(s) for s in _SOURCES],
                 "-o", str(path), "-lz",
             ]
+            # serializing the compile IS this lock's purpose: two
+            # threads racing g++ onto one .so would tear the artifact
+            # graftlint: disable=blocking-under-lock (the lock exists to serialize the one-time compile onto one .so)
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(
@@ -107,6 +110,7 @@ def _load_ext():
                     *[str(s) for s in _EXT_SOURCES],
                     "-o", str(_EXT_PATH), "-lz",
                 ]
+                # graftlint: disable=blocking-under-lock (the lock exists to serialize the one-time compile onto one .so)
                 proc = subprocess.run(cmd, capture_output=True, text=True)
                 if proc.returncode != 0:
                     return None

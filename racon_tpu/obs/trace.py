@@ -71,6 +71,10 @@ class _ThreadBuf:
         self.epoch = epoch
 
     def append(self, ev) -> None:
+        # a _ThreadBuf is single-writer by construction: _buf() hands
+        # every thread its OWN instance through thread-local storage,
+        # so these ring-state writes never race (export() reads other
+        # threads' rings, racing at worst into one stale event)
         if len(self.events) < RING_CAP:
             self.events.append(ev)
         else:

@@ -1734,6 +1734,11 @@ class TpuPoaConsensus(PallasDispatchMixin):
                               "(polish will compile on first use)", e)
 
         import threading
+        # fire-and-forget by design: the warm-up is a droppable
+        # optimization (its own except arm says so) — a daemon thread
+        # killed at exit loses nothing but a speculative compile, and
+        # the engine it warms outlives it
+        # graftlint: disable=thread-lifecycle (droppable best-effort warm-up; daemon dies harmlessly at exit)
         self._warmup = threading.Thread(target=_compile, daemon=True,
                                         name="racon-tpu-warmup")
         self._warmup.start()

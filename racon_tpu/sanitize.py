@@ -1,7 +1,7 @@
 """Runtime sanitizer (``RACON_TPU_SANITIZE=1``) — the dynamic half of
 graftlint (``tools/analysis``).
 
-Four independent detectors, all off unless the flag is set:
+Five independent detectors, all off unless the flag is set:
 
 - **SWAR shadow execution** — sampled packed-lane aligner chunks re-run
   on the int32 kernels and every output is compared bit-for-bit
@@ -28,6 +28,17 @@ Four independent detectors, all off unless the flag is set:
   pipelined ``Polisher.run()`` bounded queue and dumps every thread's
   stack to stderr when producer/consumer progress stalls past the
   timeout (deadlock triage without attaching a debugger).
+- **Lock-order witness** (round 15, the runtime companion of the
+  ``lock-discipline``/``blocking-under-lock`` lint rules) — the
+  project's named locks (:func:`named_lock`: the exec runner's
+  manifest/notes/states locks, the serve scheduler's state lock, the
+  heartbeat and index locks) are wrapped in :class:`WitnessedLock`,
+  the cross-thread acquisition-order graph is recorded (one stack per
+  first-seen edge), and any cycle — a potential deadlock, even one the
+  current interleaving never hit — is reported at process exit with
+  the stack of every edge on the cycle.  ``obs``-internal locks stay
+  plain (the witness publishes through the metrics registry, so the
+  registry lock cannot be witnessed without recursing).
 
 Import cost is nil when disabled: numpy only, jax is touched lazily and
 only for the retrace scan.
@@ -35,11 +46,12 @@ only for the retrace scan.
 
 from __future__ import annotations
 
+import atexit
 import sys
 import threading
 import time
 import traceback
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import flags
 from .obs import metrics
@@ -349,3 +361,180 @@ def queue_watchdog(name: str,
     return QueueWatchdog(
         flags.get_float("RACON_TPU_SANITIZE_WATCHDOG_S"), name,
         escalate_cb=escalate_cb).start()
+
+
+# ----------------------------------------------------- lock-order witness
+
+class LockOrderWitness:
+    """Acquisition-order recorder over the project's named locks.
+
+    Every successful acquire of a :class:`WitnessedLock` while the
+    thread already holds others adds directed edges ``held -> acquired``
+    to a process-wide graph, stamped (on first sight only — steady-state
+    cost is a TLS list append) with the acquiring stack.  A cycle in
+    that graph is a potential deadlock: two threads can reach the two
+    edges' program points concurrently and wait on each other forever,
+    whether or not *this* run's interleaving did.  :meth:`report`
+    prints every cycle with the first-seen stack of each edge on it —
+    wired to process exit via :func:`lock_witness`, and exercised by
+    the exec/serve chaos soaks under ``RACON_TPU_SANITIZE=1``.
+
+    Same-name edges are skipped: instances of one lock *class* (per-
+    shard keepers, say) share a witness name, and nesting two distinct
+    instances is ordered by a different key than the name records."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (held name, acquired name) -> first-seen acquiring stack
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._tls = threading.local()
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, name: str) -> None:
+        held = self._held()
+        if held:
+            fresh = [(p, name) for p in held
+                     if p != name and (p, name) not in self._edges]
+            if fresh:
+                stack = "".join(traceback.format_stack()[:-1])
+                with self._mu:
+                    for edge in fresh:
+                        self._edges.setdefault(edge, stack)
+        held.append(name)
+
+    def note_release(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def edges(self) -> Dict[Tuple[str, str], str]:
+        with self._mu:
+            return dict(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Every distinct simple cycle in the recorded order graph,
+        as name lists (``[a, b]`` means ``a -> b -> a``)."""
+        edges = self.edges()
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        out: List[List[str]] = []
+        seen: set = set()
+
+        def dfs(node: str, path: List[str]) -> None:
+            if len(path) > 32:   # defensive: graphs here are tiny
+                return
+            for nxt in adj.get(node, ()):
+                if nxt in path:
+                    cyc = path[path.index(nxt):]
+                    # canonical rotation (not a set): A->B->C->A and its
+                    # reverse are DIFFERENT potential deadlocks over the
+                    # same locks and must both report
+                    k = cyc.index(min(cyc))
+                    key = tuple(cyc[k:] + cyc[:k])
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(cyc)
+                else:
+                    dfs(nxt, path + [nxt])
+
+        for start in sorted(adj):
+            dfs(start, [start])
+        return out
+
+    def report(self, stream=None) -> int:
+        """Print every cycle (with each edge's first-seen acquiring
+        stack) to ``stream`` (stderr default); returns the cycle
+        count.  Registered at process exit by :func:`lock_witness`."""
+        cycles = self.cycles()
+        if not cycles:
+            return 0
+        stream = stream if stream is not None else sys.stderr
+        edges = self.edges()
+        lines: List[str] = []
+        for cyc in cycles:
+            ring = " -> ".join(cyc + [cyc[0]])
+            lines.append(f"[racon_tpu::sanitize] lock-order witness: "
+                         f"cycle {ring} (potential deadlock)")
+            for a, b in zip(cyc, cyc[1:] + [cyc[0]]):
+                lines.append(f"  edge {a} -> {b} first acquired at:")
+                lines.append(edges.get((a, b), "  <stack unavailable>")
+                             .rstrip("\n"))
+        print("\n".join(lines), file=stream)
+        stream.flush()
+        metrics.set_gauge("sanitize.lock_order_cycles", len(cycles))
+        return len(cycles)
+
+
+_witness: Optional[LockOrderWitness] = None
+_witness_mu = threading.Lock()
+
+
+def lock_witness() -> LockOrderWitness:
+    """The process-wide witness (created on first use; the exit-time
+    cycle report is registered exactly once)."""
+    global _witness
+    with _witness_mu:
+        if _witness is None:
+            _witness = LockOrderWitness()
+            atexit.register(_witness.report)
+    return _witness
+
+
+class WitnessedLock:
+    """A ``threading.Lock`` that reports its acquisition order to a
+    :class:`LockOrderWitness` under the lock's witness *name* (one name
+    per coordination point, shared by instances of the same class).
+
+    Duck-type compatible with ``threading.Condition(lock)``: the
+    Condition's default ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` fallbacks drive ``acquire``/``release``, so a
+    ``cond.wait()`` correctly pops and re-pushes the witness's held
+    record around the sleep."""
+
+    def __init__(self, name: str,
+                 witness: Optional[LockOrderWitness] = None):
+        self.name = name
+        self._lock = threading.Lock()
+        self._witness = witness if witness is not None else lock_witness()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self._witness.note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._witness.note_release(self.name)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "WitnessedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<WitnessedLock {self.name!r} at {id(self):#x}>"
+
+
+def named_lock(name: str):
+    """A lock for a named cross-thread coordination point: witnessed
+    (:class:`WitnessedLock`) when the sanitizer is armed at creation
+    time, a plain ``threading.Lock`` otherwise — the zero-overhead
+    default mirrors every other sanitizer half."""
+    if enabled():
+        return WitnessedLock(name)
+    return threading.Lock()

@@ -58,7 +58,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .. import faults, flags
+from .. import faults, flags, sanitize
 from ..core.polisher import PolisherType, create_polisher
 from ..exec import heartbeat as hb
 from ..exec import lease as lease_mod
@@ -232,7 +232,12 @@ class PolishServer:
         self.autostart = autostart
 
         self._slots: Optional[List[_ChipWorker]] = None
-        self._lock = threading.Lock()
+        # first slot-pool resolution is raced by connection handlers
+        # (admission warm-up) against startup (_warm_pool)
+        self._slots_lock = sanitize.named_lock("serve.slots")
+        # the scheduler state lock (queue, counts, footprint); under
+        # RACON_TPU_SANITIZE=1 both feed the lock-order witness
+        self._lock = sanitize.named_lock("serve.state")
         self._cond = threading.Condition(self._lock)
         self._queue: List[Job] = []            # admitted, not yet running
         self._jobs: Dict[str, Job] = {}
@@ -265,30 +270,39 @@ class PolishServer:
         per-run state and are never shared across concurrent jobs)."""
         if self._slots is not None:
             return self._slots
-        n = 1
-        explicit = self.chips_requested > 0 \
-            or flags.get_int("RACON_TPU_CHIPS") > 0
-        if explicit:
-            from ..parallel import topology
-            n = topology.resolve_chips(self.chips_requested)
-        elif "tpu" in (self.aligner_backend, self.consensus_backend):
-            from ..parallel import topology
-            devs = topology.local_devices()
-            if len(devs) > 1 and \
-                    getattr(devs[0], "platform", "cpu") != "cpu":
-                n = len(devs)
-        if n <= 1:
-            slots = [_ChipWorker(self, ChipSlot(0, None), pinned=False)]
-        else:
-            from ..parallel import topology
-            topo = topology.Topology(n)
-            slots = [_ChipWorker(self, s, pinned=True)
-                     for s in topo.slots]
-        for k in range(len(slots), max(1, self.workers_requested)):
-            extra = _ChipWorker(self, ChipSlot(k, None), pinned=False)
-            extra.worker = f"{self.worker}#w{k}"
-            slots.append(extra)
-        self._slots = slots
+        # double-checked under its own lock: a connection handler's
+        # admission warm-up and the startup warm pool can both trigger
+        # the first resolution — two pools would split the warm jit
+        # caches and double every engine's device footprint
+        with self._slots_lock:
+            if self._slots is not None:
+                return self._slots
+            n = 1
+            explicit = self.chips_requested > 0 \
+                or flags.get_int("RACON_TPU_CHIPS") > 0
+            if explicit:
+                from ..parallel import topology
+                n = topology.resolve_chips(self.chips_requested)
+            elif "tpu" in (self.aligner_backend, self.consensus_backend):
+                from ..parallel import topology
+                devs = topology.local_devices()
+                if len(devs) > 1 and \
+                        getattr(devs[0], "platform", "cpu") != "cpu":
+                    n = len(devs)
+            if n <= 1:
+                slots = [_ChipWorker(self, ChipSlot(0, None),
+                                     pinned=False)]
+            else:
+                from ..parallel import topology
+                topo = topology.Topology(n)
+                slots = [_ChipWorker(self, s, pinned=True)
+                         for s in topo.slots]
+            for k in range(len(slots), max(1, self.workers_requested)):
+                extra = _ChipWorker(self, ChipSlot(k, None),
+                                    pinned=False)
+                extra.worker = f"{self.worker}#w{k}"
+                slots.append(extra)
+            self._slots = slots
         return slots
 
     def _warm_pool(self) -> None:
@@ -643,6 +657,7 @@ class PolishServer:
         return True
 
     def _op_cancel(self, conn, job: Job) -> bool:
+        cancelled = False
         with self._cond:
             if job in self._queue:
                 self._queue.remove(job)
@@ -651,9 +666,14 @@ class PolishServer:
                 self._counts["cancelled"] += 1
                 self._retired.append(job.id)  # bounded-history horizon
                 job.done.set()
-                protocol.send_msg(conn, {"ok": True, "job": job.id,
-                                         "state": job.state})
-                return True
+                cancelled = True
+        # reply OUTSIDE the scheduler lock (blocking-under-lock): a
+        # client slow to drain its socket must not stall every worker
+        # contending for the state lock
+        if cancelled:
+            protocol.send_msg(conn, {"ok": True, "job": job.id,
+                                     "state": job.state})
+            return True
         protocol.send_msg(conn, {
             "ok": False, "job": job.id, "state": job.state,
             "error": f"job {job.id} is not queued ({job.state}) — a "
@@ -782,6 +802,10 @@ class PolishServer:
         # daemon's trace is unbounded by definition)
         from ..obs import trace
         trace.activate()
+        # serve_forever runs on exactly ONE thread per server (the
+        # process main thread in production, the single spawner thread
+        # in tests) — its attribute writes below never race themselves
+        # graftlint: disable=lock-discipline (serve_forever runs on exactly one thread per server instance)
         self._listener = self._bind()
         self._warm_pool()
         if self.autostart:
@@ -806,6 +830,7 @@ class PolishServer:
                                      args=(conn,), daemon=True)
                 t.start()
                 self._conn_threads.append(t)
+                # graftlint: disable=lock-discipline (serve_forever runs on exactly one thread per server instance)
                 self._conn_threads = [c for c in self._conn_threads
                                       if c.is_alive()]
         finally:

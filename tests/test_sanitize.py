@@ -335,6 +335,132 @@ def test_queue_watchdog_quiet_while_beating():
     assert wd.fired == 0 and buf.getvalue() == ""
 
 
+# ------------------------------------------------------ lock-order witness
+
+def test_lock_witness_fires_on_inverted_order():
+    """The acceptance pair, hostile half: two locks taken A->B on one
+    code path and B->A on another build a cycle in the order graph —
+    a potential deadlock even though this single-threaded run never
+    hangs — and the report carries both edges' stacks."""
+    w = sanitize.LockOrderWitness()
+    a = sanitize.WitnessedLock("exec.A", witness=w)
+    b = sanitize.WitnessedLock("exec.B", witness=w)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = w.cycles()
+    assert len(cycles) == 1 and set(cycles[0]) == {"exec.A", "exec.B"}
+    buf = io.StringIO()
+    assert w.report(buf) == 1
+    out = buf.getvalue()
+    assert "potential deadlock" in out
+    # both edges of the cycle print with their first-seen stacks
+    assert "edge exec.A -> exec.B" in out
+    assert "edge exec.B -> exec.A" in out
+    assert out.count("test_lock_witness_fires_on_inverted_order") >= 2
+
+
+def test_lock_witness_silent_on_ordered_acquisition():
+    """The acceptance pair, clean half: nesting that always follows one
+    global order (A then B) builds an acyclic graph — no report."""
+    w = sanitize.LockOrderWitness()
+    a = sanitize.WitnessedLock("exec.A", witness=w)
+    b = sanitize.WitnessedLock("exec.B", witness=w)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert w.cycles() == []
+    buf = io.StringIO()
+    assert w.report(buf) == 0 and buf.getvalue() == ""
+
+
+def test_lock_witness_cross_thread_edges():
+    """Edges recorded on different threads still compose into one
+    cycle: thread 1 takes A->B, thread 2 takes B->A — the classic
+    two-thread deadlock shape, witnessed without ever deadlocking."""
+    import threading
+
+    w = sanitize.LockOrderWitness()
+    a = sanitize.WitnessedLock("serve.A", witness=w)
+    b = sanitize.WitnessedLock("serve.B", witness=w)
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    for fn in (t1, t2):  # sequential: order edges, never the hang
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert len(w.cycles()) == 1
+
+
+def test_named_lock_plain_when_disabled(monkeypatch):
+    import threading
+
+    monkeypatch.delenv("RACON_TPU_SANITIZE", raising=False)
+    lock = sanitize.named_lock("x")
+    assert isinstance(lock, type(threading.Lock()))
+
+
+def test_named_lock_witnessed_and_condition_compatible(sanitize_on):
+    """serve builds threading.Condition(named_lock(...)): the witness
+    wrapper must drive the Condition protocol (wait releases/reacquires
+    through acquire/release, so the held record stays truthful)."""
+    import threading
+
+    lock = sanitize.named_lock("serve.test")
+    assert isinstance(lock, sanitize.WitnessedLock)
+    cond = threading.Condition(lock)
+    ready = threading.Event()
+
+    def waker():
+        ready.wait(5.0)
+        with cond:
+            cond.notify_all()
+
+    t = threading.Thread(target=waker)
+    t.start()
+    with cond:
+        ready.set()
+        cond.wait(5.0)
+    t.join()
+    # balanced acquire/release: nothing held, no edges, no cycles
+    assert sanitize.lock_witness().cycles() == []
+
+
+def test_exec_run_under_witness_is_acyclic(sanitize_on, tmp_path):
+    """Armed end-to-end: a real 2-shard exec run constructed under
+    RACON_TPU_SANITIZE=1 gets WitnessedLocks for its manifest/notes/
+    states coordination points, and the full drain (claims, state
+    saves, snapshot writes, heartbeat) leaves the process-wide
+    acquisition-order graph acyclic — the invariant the CI chaos soaks
+    lock in at scale."""
+    from racon_tpu.exec.runner import ShardRunner
+    from test_columnar_init import write_synthetic_assembly
+
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=5, n_contigs=2,
+                                          contig=1200)
+    runner = ShardRunner(str(rp), str(pp), str(lp), n_shards=2,
+                         num_threads=2,
+                         work_dir=str(tmp_path / "wd"))
+    assert isinstance(runner._mf_lock, sanitize.WitnessedLock)
+    out = io.BytesIO()
+    runner.run(out)
+    assert out.getvalue().startswith(b">")
+    assert sanitize.lock_witness().cycles() == []
+
+
 def test_stalled_consumer_triggers_watchdog(tmp_path, monkeypatch,
                                             capsys):
     """Integration half: a Polisher.run() whose consensus consumer

@@ -1,13 +1,17 @@
-"""graftlint — the repo's first-party JAX-hazard linter.
+"""graftlint — the repo's first-party JAX-hazard + concurrency linter.
 
 AST-based and repo-aware: rules consult a project-wide function index,
-jit-reachability with interprocedural taint, and a logging-function
-closure (see :mod:`tools.analysis.astutil` /
-:mod:`tools.analysis.rules`).  Run it as::
+jit-reachability with interprocedural taint, a logging-function
+closure, and (round 15) the concurrency layer — thread entry-point
+discovery, per-function execution contexts, lock inventories, guard
+regions and a blocking-call closure (see
+:mod:`tools.analysis.astutil` / :mod:`tools.analysis.rules` /
+:mod:`tools.analysis.concurrency`).  Run it as::
 
     python -m tools.analysis racon_tpu tests tools bench.py
     python -m tools.analysis --selftest        # fixture-based rule tests
     python -m tools.analysis --list            # rule inventory
+    python -m tools.analysis --json PATH       # machine-readable output
 
 Suppression: a finding is silenced by a pragma **with a reason** on the
 finding line or the line above::
@@ -20,7 +24,10 @@ means zero unsuppressed findings.
 
 The runtime half of the tool lives in ``racon_tpu/sanitize.py``
 (``RACON_TPU_SANITIZE=1``): SWAR int32 shadow execution, kernel-output
-canaries, the jit-retrace phase budget and the pipeline queue watchdog.
+canaries, the jit-retrace phase budget, the pipeline queue watchdog,
+and the lock-order witness over the project's named locks (cycle =
+potential deadlock, reported with the stack of every edge at process
+exit).
 """
 
 from __future__ import annotations
@@ -100,6 +107,7 @@ def apply_pragmas(module: Module,
             f.message += " [pragma present but missing its (reason)]"
             reported.append(f)
         else:
+            f.pragma = verdict[1]
             suppressed.append(f)
     return reported, suppressed
 
@@ -156,18 +164,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from .selftest import run_selftest
         return run_selftest()
     quiet = "--quiet" in argv
+    as_json = "--json" in argv
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
-        print("usage: python -m tools.analysis [--selftest|--list] "
-              "PATH [PATH...]", file=sys.stderr)
+        print("usage: python -m tools.analysis [--selftest|--list|"
+              "--json] PATH [PATH...]", file=sys.stderr)
         return 2
     try:
         reported, suppressed = run(paths)
     except (FileNotFoundError, SyntaxError) as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
-    for f in reported:
-        print(f)
+    if as_json:
+        # machine-readable output for CI annotation/aggregation: every
+        # finding (reported AND pragma-suppressed, distinguished by the
+        # pragma field) as one JSON object on stdout
+        import json
+        print(json.dumps({
+            "findings": [f.as_dict() for f in reported],
+            "suppressed": [f.as_dict() for f in suppressed],
+        }, indent=1))
+    else:
+        for f in reported:
+            print(f)
     if not quiet:
         print(f"graftlint: {len(reported)} finding(s), "
               f"{len(suppressed)} suppressed by pragma", file=sys.stderr)

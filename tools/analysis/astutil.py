@@ -9,6 +9,16 @@ the linter runs without jax (and on broken code). The central products:
   keyword-only-static convention), tracer-reachability over the repo
   call graph, and an interprocedural **taint** of traced values that the
   ``tracer-leak`` rule consumes.
+- the **concurrency layer** (round 15): thread entry-point discovery
+  (every ``threading.Thread(target=...)``, targets resolved),
+  **execution contexts** per function (which thread roots — and/or the
+  main path — can run it, propagated over an unambiguous call graph),
+  per-class/per-module **lock inventories** (``self._lock =
+  threading.Lock()``, ``Condition(self._lock)`` aliases), lexical
+  **guard regions** (:func:`guarded_nodes`), and a **blocking-call
+  closure** (functions that transitively sleep/fsync/send/queue-block).
+  The concurrency/durability rule pack in
+  :mod:`tools.analysis.concurrency` consumes all of these.
 - :func:`dotted` — best-effort dotted name of an expression
   (``jax.jit``, ``os.environ.get``), the workhorse of call matching.
 
@@ -39,6 +49,24 @@ STATIC_CALLS = {"isinstance", "len", "type", "hasattr", "callable", "id",
                 "repr", "str", "format"}
 # call prefixes that produce traced values
 TRACED_PREFIXES = ("jnp.", "lax.", "jax.", "pl.", "pltpu.")
+
+# ------------------------------------------------- concurrency vocabulary
+THREAD_CTORS = {"threading.Thread", "Thread"}
+LOCK_CTORS = {"threading.Lock", "Lock", "threading.RLock", "RLock",
+              "named_lock", "sanitize.named_lock"}
+CONDITION_CTORS = {"threading.Condition", "Condition"}
+QUEUE_CTORS = {"Queue", "queue.Queue", "SimpleQueue", "queue.SimpleQueue"}
+MAIN_CONTEXT = "main"
+# attribute-call names that are overwhelmingly stdlib-object protocol
+# (Thread.start/join, Event.set/wait, dict/list mutation, file I/O):
+# resolving them to same-named repo functions through a non-self
+# receiver would wire bogus call-graph edges
+GENERIC_METHODS = {"start", "join", "set", "clear", "is_set", "wait",
+                   "acquire", "release", "get", "put", "get_nowait",
+                   "put_nowait", "append", "add", "pop", "remove",
+                   "update", "items", "keys", "values", "close",
+                   "flush", "write", "read", "readline", "send",
+                   "sendall", "recv", "accept", "connect"}
 
 
 def dotted(node: ast.AST) -> Optional[str]:
@@ -424,6 +452,374 @@ class Project:
             return True
         return any(id(c) in self.logging_functions()
                    for c in self.resolve(call))
+
+    # ------------------------------------------------- concurrency layer
+
+    def resolve_unique(self, call: ast.Call,
+                       caller: Optional[FuncInfo]) -> Optional[FuncInfo]:
+        """The single repo definition a call can mean, or None.
+
+        Unlike :meth:`resolve` (every same-named candidate — right for
+        may-analyses like the logging closure), context propagation
+        must not smear thread-ness through common names (``run`` is
+        defined by half the engine classes): a ``self.m(...)`` call
+        binds to the enclosing class's own method; a bare name binds to
+        a lexically nested def first; anything else resolves only when
+        exactly one definition carries the name."""
+        name = last_segment(dotted(call.func))
+        if name is None:
+            return None
+        if isinstance(call.func, ast.Attribute):
+            on_self = (isinstance(call.func.value, ast.Name)
+                       and call.func.value.id in ("self", "cls"))
+            if on_self and caller is not None and caller.class_name:
+                own = [c for c in self.by_name.get(name, [])
+                       if c.class_name == caller.class_name
+                       and c.module is caller.module]
+                if own:
+                    return own[0]
+            if not on_self and name in GENERIC_METHODS:
+                return None
+        if caller is not None and isinstance(call.func, ast.Name):
+            for scope in [caller] + self.enclosing(caller):
+                for c in self.by_name.get(name, []):
+                    if c.parent is scope:
+                        return c
+        cands = self.by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def _resolve_callable_ref(self, expr: ast.AST,
+                              owner: Optional[FuncInfo],
+                              module: Module) -> List[FuncInfo]:
+        """Repo definitions a callable *reference* (a ``target=`` value)
+        can mean: ``self.m`` binds in the owner's class, a bare name
+        binds to a nested def first, then uniquely by name."""
+        name = last_segment(dotted(expr))
+        if name is None:
+            return []
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id in ("self", "cls"):
+            cls = owner.class_name if owner else None
+            own = [c for c in self.by_name.get(name, [])
+                   if c.class_name == cls and c.module is module]
+            if own:
+                return own
+        if isinstance(expr, ast.Name) and owner is not None:
+            for scope in [owner] + self.enclosing(owner):
+                for c in self.by_name.get(name, []):
+                    if c.parent is scope:
+                        return [c]
+        cands = self.by_name.get(name, [])
+        return cands if len(cands) == 1 else []
+
+    def thread_spawns(self) -> List["ThreadSpawn"]:
+        """Every ``threading.Thread(target=...)`` construction in the
+        project, with its resolved target functions — the thread
+        entry-point discovery the concurrency rules build on."""
+        if getattr(self, "_spawns", None) is not None:
+            return self._spawns
+        spawns: List[ThreadSpawn] = []
+        for mod in self.modules:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and dotted(node.func) in THREAD_CTORS):
+                    continue
+                owner = self._enclosing_function(mod, node)
+                target = None
+                daemon = False
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+                    elif kw.arg == "daemon" \
+                            and isinstance(kw.value, ast.Constant):
+                        daemon = bool(kw.value.value)
+                targets = ([] if target is None else
+                           self._resolve_callable_ref(target, owner, mod))
+                spawns.append(ThreadSpawn(mod, node, owner, targets,
+                                          daemon))
+        self._spawns = spawns
+        return spawns
+
+    def _enclosing_function(self, module: Module,
+                            node: ast.AST) -> Optional[FuncInfo]:
+        """The innermost function whose body contains ``node`` (by line
+        span — cheap and adequate for spawn-site attribution)."""
+        best: Optional[FuncInfo] = None
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            return None
+        for fi in self.functions:
+            if fi.module is not module:
+                continue
+            end = getattr(fi.node, "end_lineno", fi.node.lineno)
+            if fi.node.lineno <= lineno <= end:
+                if best is None or fi.node.lineno > best.node.lineno:
+                    best = fi
+        return best
+
+    def thread_roots(self) -> List[FuncInfo]:
+        """Functions that run as thread entry points (deduped)."""
+        seen: Set[int] = set()
+        out: List[FuncInfo] = []
+        for spawn in self.thread_spawns():
+            for fi in spawn.targets:
+                if id(fi) not in seen:
+                    seen.add(id(fi))
+                    out.append(fi)
+        return out
+
+    def exec_contexts(self) -> Dict[int, Set[str]]:
+        """``id(FuncInfo) -> execution context labels``: the set of
+        thread roots (``thread:<qualname>``) — and/or :data:`MAIN_CONTEXT`
+        — whose dynamic extent can reach the function.  Propagated to a
+        fixpoint over the *unambiguous* call graph
+        (:meth:`resolve_unique`): a function with no repo caller and no
+        spawn site is a main entry (CLI mains, public API, tests)."""
+        if getattr(self, "_contexts", None) is not None:
+            return self._contexts
+        roots = {id(fi): f"thread:{fi.qualname}"
+                 for fi in self.thread_roots()}
+        edges: Dict[int, Set[int]] = {}
+        called: Set[int] = set()
+        for fi in self.functions:
+            for call in iter_own_calls(fi.node):
+                tgt = self.resolve_unique(call, fi)
+                if tgt is not None:
+                    edges.setdefault(id(fi), set()).add(id(tgt))
+                    called.add(id(tgt))
+        ctx: Dict[int, Set[str]] = {}
+        for fi in self.functions:
+            k = id(fi)
+            ctx[k] = set()
+            if k in roots:
+                ctx[k].add(roots[k])
+            if k not in called and k not in roots:
+                ctx[k].add(MAIN_CONTEXT)
+        # module-level calls run on the importing (main) thread
+        for mod in self.modules:
+            for node in module_level_calls(mod.tree):
+                tgt = self.resolve_unique(node, None)
+                if tgt is not None:
+                    ctx[id(tgt)].add(MAIN_CONTEXT)
+        changed = True
+        guard = 0
+        while changed and guard < 1000:
+            guard += 1
+            changed = False
+            for src, dsts in edges.items():
+                for dst in dsts:
+                    if not ctx[src] <= ctx[dst]:
+                        ctx[dst] |= ctx[src]
+                        changed = True
+        self._contexts = ctx
+        return ctx
+
+    def lock_inventory(self, module: Module) -> "LockInventory":
+        """The module's named locks: per-class ``self.X`` lock
+        attributes (``Condition(self.Y)`` aliases to ``Y``) and
+        module-level lock globals — what :func:`guarded_nodes` treats
+        as guards."""
+        cache = getattr(self, "_lock_inv", None)
+        if cache is None:
+            cache = self._lock_inv = {}
+        inv = cache.get(id(module))
+        if inv is not None:
+            return inv
+        by_class: Dict[str, Dict[str, str]] = {}
+        module_locks: Set[str] = set()
+        for fi in self.functions:
+            if fi.module is not module or not fi.class_name:
+                continue
+            attrs = by_class.setdefault(fi.class_name, {})
+            for node in iter_own_nodes(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                fn = dotted(node.value.func)
+                for t in node.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if fn in LOCK_CTORS:
+                        attrs[t.attr] = t.attr
+                    elif fn in CONDITION_CTORS:
+                        # Condition(self.Y) holds Y; a bare Condition()
+                        # owns its internal lock — canonical = itself
+                        args = node.value.args
+                        if args and isinstance(args[0], ast.Attribute) \
+                                and isinstance(args[0].value, ast.Name) \
+                                and args[0].value.id == "self":
+                            attrs[t.attr] = args[0].attr
+                        else:
+                            attrs[t.attr] = t.attr
+        for node in ast.iter_child_nodes(module.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted(node.value.func) in LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        module_locks.add(t.id)
+        inv = LockInventory(by_class, module_locks)
+        cache[id(module)] = inv
+        return inv
+
+    # ------------------------------------------------- blocking closure
+
+    def _call_blocks_directly(self, call: ast.Call,
+                              queue_names: Set[str]) -> Optional[str]:
+        """If this call can block the calling thread (sleep, fsync,
+        socket I/O, subprocess, device sync, bounded-queue get/put),
+        name the offending operation; else None.  ``Condition.wait``
+        releases its lock and is exempt (receivers named ``*cond*``)."""
+        fn = dotted(call.func)
+        if fn in ("time.sleep",) or fn == "sleep":
+            return "time.sleep"
+        if fn and fn.startswith("subprocess."):
+            return fn
+        if fn in ("os.fsync", "jax.block_until_ready"):
+            return fn
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            recv = dotted(call.func.value) or ""
+            if attr in ("sendall", "recv", "recvfrom", "accept",
+                        "connect", "fsync", "block_until_ready"):
+                return f".{attr}"
+            if attr == "wait" and "cond" not in recv.lower():
+                return ".wait"
+            if attr in ("get", "put"):
+                seg = last_segment(recv) or ""
+                if "queue" in seg.lower() or seg in queue_names:
+                    return f"{seg}.{attr}"
+        return None
+
+    def _queue_names(self, fi: FuncInfo) -> Set[str]:
+        """Local names bound to ``Queue(...)`` in ``fi`` or a lexically
+        enclosing function (the polisher's ``ranges`` pattern)."""
+        names: Set[str] = set()
+        for f in [fi] + self.enclosing(fi):
+            for node in iter_own_nodes(f.node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and dotted(node.value.func) in QUEUE_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+        return names
+
+    def blocking_functions(self) -> Set[int]:
+        """ids of repo functions that (transitively, over the
+        unambiguous call graph) can block: the interprocedural half of
+        the ``blocking-under-lock`` rule — ``_save`` blocks because
+        ``save_manifest -> durable_write -> atomic_write`` fsyncs."""
+        if getattr(self, "_blocking", None) is not None:
+            return self._blocking
+        blocks: Set[int] = set()
+        for fi in self.functions:
+            qnames = self._queue_names(fi)
+            for call in iter_own_calls(fi.node):
+                if self._call_blocks_directly(call, qnames):
+                    blocks.add(id(fi))
+                    break
+        changed = True
+        guard = 0
+        while changed and guard < 100:
+            guard += 1
+            changed = False
+            for fi in self.functions:
+                if id(fi) in blocks:
+                    continue
+                for call in iter_own_calls(fi.node):
+                    tgt = self.resolve_unique(call, fi)
+                    if tgt is not None and id(tgt) in blocks:
+                        blocks.add(id(fi))
+                        changed = True
+                        break
+        self._blocking = blocks
+        return blocks
+
+    def call_blocks(self, call: ast.Call,
+                    caller: FuncInfo) -> Optional[str]:
+        """Why a call (directly or via a repo callee) can block, or
+        None."""
+        why = self._call_blocks_directly(call, self._queue_names(caller))
+        if why is not None:
+            return why
+        tgt = self.resolve_unique(call, caller)
+        if tgt is not None and id(tgt) in self.blocking_functions():
+            return f"{tgt.qualname}() (transitively blocking)"
+        return None
+
+
+@dataclass
+class ThreadSpawn:
+    """One ``threading.Thread(target=...)`` construction site."""
+
+    module: Module
+    call: ast.Call
+    spawner: Optional[FuncInfo]     # None: module-level spawn
+    targets: List[FuncInfo]         # resolved entry points (may be [])
+    daemon: bool
+
+
+@dataclass
+class LockInventory:
+    """One module's named locks (see :meth:`Project.lock_inventory`)."""
+
+    by_class: Dict[str, Dict[str, str]]   # class -> {attr: canonical}
+    module_locks: Set[str]                # module-global lock names
+
+    def class_locks(self, class_name: Optional[str]) -> Dict[str, str]:
+        return self.by_class.get(class_name or "", {})
+
+
+def guarded_nodes(fi: FuncInfo, inventory: LockInventory):
+    """Yield ``(node, frozenset(held canonical lock names))`` for every
+    own node of ``fi``, tracking the lexical ``with self._lock:`` /
+    ``with _lock:`` guard regions. Nested function bodies are excluded
+    (they execute later, not under the lock)."""
+    class_locks = inventory.class_locks(fi.class_name)
+
+    def walk(node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            child_held = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in child.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Attribute) \
+                            and isinstance(ce.value, ast.Name) \
+                            and ce.value.id == "self" \
+                            and ce.attr in class_locks:
+                        acquired.add(f"self.{class_locks[ce.attr]}")
+                    elif isinstance(ce, ast.Name) \
+                            and ce.id in inventory.module_locks:
+                        acquired.add(ce.id)
+                if acquired:
+                    child_held = held | frozenset(acquired)
+            yield child, child_held
+            yield from walk(child, child_held)
+
+    yield from walk(fi.node, frozenset())
+
+
+def module_level_calls(tree: ast.AST):
+    """Calls made at module import time (outside any function body —
+    class bodies DO run at import)."""
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            stack.append(child)
 
 
 # --------------------------------------------------------- tree iteration

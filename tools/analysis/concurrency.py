@@ -1,0 +1,371 @@
+"""The concurrency & durability rule pack (round 15).
+
+Everything the round-8 rules could not see: the chip-worker pools and
+lease keepers in ``exec/``, the socket/worker/heartbeat threads in
+``serve/``, thread-local metric scopes in ``obs/``, and the manifest
+durability protocol kill-then-resume correctness depends on.  All five
+rules consume the concurrency layer in :mod:`tools.analysis.astutil`
+(thread entry-point discovery, execution contexts, lock inventories,
+guard regions, the blocking-call closure).
+
+| rule                    | catches                                      |
+| ----------------------- | -------------------------------------------- |
+| lock-discipline         | a self attribute written from >=2 execution  |
+|                         | contexts (thread roots / the main path) with |
+|                         | no common guarding lock                      |
+| blocking-under-lock     | sleep / socket I/O / subprocess / fsync /    |
+|                         | device sync / bounded-queue get-put while a  |
+|                         | named lock is held (directly or through a    |
+|                         | transitively-blocking repo function)         |
+| atomic-write-discipline | raw write-mode ``open()`` in the durability- |
+|                         | critical packages; tmp->rename+fsync writers |
+|                         | are allowlisted                              |
+| thread-lifecycle        | threads started with no join and no          |
+|                         | stop-event wiring (leak / lost-write at exit)|
+| scope-discipline        | metric writes naming the ``job.`` scope by   |
+|                         | hand instead of ``metrics.job_scope``        |
+
+The runtime companion is the lock-order witness in
+``racon_tpu/sanitize.py`` (``RACON_TPU_SANITIZE=1``): the named locks
+these rules reason about statically are wrapped at runtime and their
+acquisition-order graph is checked for cycles at process exit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .astutil import (MAIN_CONTEXT, FuncInfo, Module, Project, dotted,
+                      guarded_nodes, iter_own_calls, iter_own_nodes,
+                      last_segment)
+from .rules import Finding, Rule
+
+
+def _fmt_contexts(contexts: Set[str]) -> str:
+    return ", ".join(sorted(contexts))
+
+
+# --------------------------------------------------------- lock-discipline
+
+class LockDisciplineRule(Rule):
+    """A ``self.X`` attribute assigned from two or more execution
+    contexts — distinct thread roots, or a thread root and the main
+    path — with no lock common to every write site is a data race (or,
+    at best, an undocumented reliance on the GIL's per-bytecode
+    atomicity).  ``__init__`` writes are exempt (``Thread.start()`` is
+    a happens-before edge), as are the lock/condition attributes
+    themselves.  A deliberately unguarded write (a slot drained by
+    exactly one thread, a monotonic watchdog timestamp) takes a
+    reasoned pragma."""
+
+    name = "lock-discipline"
+    SKIP_METHODS = {"__init__", "__new__", "__post_init__"}
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        contexts = project.exec_contexts()
+        inventory = project.lock_inventory(module)
+        # (class, attr) -> [(site node, held locks, site contexts)]
+        writes: Dict[Tuple[str, str], List] = {}
+        for fi in project.functions:
+            if fi.module is not module or not fi.class_name \
+                    or fi.name in self.SKIP_METHODS:
+                continue
+            lock_attrs = set(inventory.class_locks(fi.class_name))
+            ctx = contexts.get(id(fi), set())
+            for node, held in guarded_nodes(fi, inventory):
+                for attr in self._written_attrs(node):
+                    if attr in lock_attrs:
+                        continue
+                    writes.setdefault((fi.class_name, attr), []).append(
+                        (node, held, ctx))
+        out: List[Finding] = []
+        for (cls, attr), sites in sorted(writes.items()):
+            all_ctx: Set[str] = set()
+            for _, _, ctx in sites:
+                all_ctx |= ctx
+            if len(all_ctx) < 2:
+                continue
+            common = frozenset.intersection(
+                *[frozenset(held) for _, held, _ in sites])
+            if common:
+                continue
+            # report at the first *unguarded* site (the fix target)
+            node = next((n for n, held, _ in sites if not held),
+                        sites[0][0])
+            out.append(self.finding(
+                module, node,
+                f"`{cls}.{attr}` is written from "
+                f"{len(all_ctx)} execution contexts "
+                f"({_fmt_contexts(all_ctx)}) with no common guarding "
+                f"lock — hold one lock across every write (or pragma "
+                f"with the reason the race is benign)"))
+        return out
+
+    @staticmethod
+    def _written_attrs(node: ast.AST):
+        """Names of ``self.X`` (or ``self.X[...]``) assignment targets
+        of ``node``."""
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                       else [t]):
+                if isinstance(el, ast.Subscript):
+                    el = el.value
+                if isinstance(el, ast.Attribute) \
+                        and isinstance(el.value, ast.Name) \
+                        and el.value.id == "self":
+                    yield el.attr
+
+
+# ----------------------------------------------------- blocking-under-lock
+
+class BlockingUnderLockRule(Rule):
+    """A blocking call made while a named lock is held stalls every
+    thread contending for that lock (and, for the serve/exec
+    registries, the whole scheduler): ``time.sleep``, socket
+    send/recv/accept, ``subprocess``, ``os.fsync``,
+    ``block_until_ready``, Event ``.wait``, bounded-queue ``get``/
+    ``put`` — directly, or through a repo function that transitively
+    blocks (the ``save_manifest -> durable_write -> fsync`` chain).
+    ``Condition.wait`` releases its lock and is exempt.  A hold that
+    exists precisely to serialize the blocking operation (the manifest
+    snapshot writer) takes a reasoned pragma."""
+
+    name = "blocking-under-lock"
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        inventory = project.lock_inventory(module)
+        out: List[Finding] = []
+        for fi in project.functions:
+            if fi.module is not module:
+                continue
+            for node, held in guarded_nodes(fi, inventory):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                why = project.call_blocks(node, fi)
+                if why is None:
+                    continue
+                out.append(self.finding(
+                    module, node,
+                    f"blocking call {why} while holding "
+                    f"{_fmt_contexts(set(held))} in `{fi.qualname}` — "
+                    f"move it outside the lock (or pragma with why the "
+                    f"hold must cover it)"))
+        return out
+
+
+# ------------------------------------------------- atomic-write-discipline
+
+class AtomicWriteDisciplineRule(Rule):
+    """In the durability-critical packages (``exec``, ``serve``,
+    ``obs``), every write-mode ``open()`` must be the tmp -> fsync ->
+    atomic-rename protocol (``manifest.atomic_write`` /
+    ``durable_write`` / ``report.atomic_write_bytes``) or route through
+    it: a raw ``open(path, "wb")`` can leave a torn artifact that a
+    resume or a concurrent worker then trusts.  Allowlisted: functions
+    that open a ``*.tmp*`` name and ``os.replace``/``os.rename`` it
+    into place (the protocol's own writers).  A deliberately raw write
+    (a re-derivable scratch file) takes a reasoned pragma."""
+
+    name = "atomic-write-discipline"
+    WRITE_MODES = ("w", "a", "x")
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith(".py") and rel.startswith(
+            ("racon_tpu/exec/", "racon_tpu/serve/", "racon_tpu/obs/"))
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        for fi in project.functions:
+            if fi.module is not module:
+                continue
+            allowlisted = self._renames_tmp(fi)
+            for call in iter_own_calls(fi.node):
+                if dotted(call.func) != "open" or not call.args:
+                    continue
+                mode = (call.args[1] if len(call.args) >= 2 else
+                        next((kw.value for kw in call.keywords
+                              if kw.arg == "mode"), None))
+                if mode is None or not (isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and mode.value.startswith(self.WRITE_MODES)):
+                    continue
+                if allowlisted and self._is_tmp_name(fi, call.args[0]):
+                    continue
+                out.append(self.finding(
+                    module, call,
+                    f"raw `open(..., {mode.value!r})` in "
+                    f"`{fi.qualname}` bypasses the durable-write "
+                    f"protocol — route through "
+                    f"manifest.atomic_write/durable_write or "
+                    f"report.atomic_write_bytes (or pragma a "
+                    f"re-derivable scratch file with the reason)"))
+        return out
+
+    @staticmethod
+    def _renames_tmp(fi: FuncInfo) -> bool:
+        return any(dotted(c.func) in ("os.replace", "os.rename")
+                   for c in iter_own_calls(fi.node))
+
+    @staticmethod
+    def _is_tmp_name(fi: FuncInfo, expr: ast.AST) -> bool:
+        """Does the opened path (or the local it names) carry a
+        ``.tmp`` marker — the tmp half of tmp -> rename?"""
+
+        def has_tmp(e: ast.AST) -> bool:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str) and ".tmp" in n.value:
+                    return True
+            return False
+
+        if has_tmp(expr):
+            return True
+        if isinstance(expr, ast.Name):
+            for node in iter_own_nodes(fi.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets) and has_tmp(node.value):
+                    return True
+        return False
+
+
+# ----------------------------------------------------------- thread-lifecycle
+
+class ThreadLifecycleRule(Rule):
+    """Every started thread needs an owner: either its entry point
+    loops on a stop/abort event (``self._stop.wait(...)`` /
+    ``.is_set()`` — the daemon-with-shutdown pattern), or something in
+    the spawning class/module ``join()``s it.  A fire-and-forget
+    non-daemon thread hangs interpreter exit; a fire-and-forget daemon
+    thread is killed mid-write at exit with no flush.  A deliberately
+    abandoned thread (a droppable best-effort warm-up) takes a
+    reasoned pragma."""
+
+    name = "thread-lifecycle"
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        for spawn in project.thread_spawns():
+            if spawn.module is not module:
+                continue
+            if any(self._stop_wired(t) for t in spawn.targets):
+                continue
+            if self._scope_joins(project, spawn):
+                continue
+            what = (spawn.targets[0].qualname if spawn.targets
+                    else "<unresolved target>")
+            out.append(self.finding(
+                module, spawn.call,
+                f"thread running `{what}` is started without join-or-"
+                f"abort-event wiring — join it, or loop its body on a "
+                f"stop event (or pragma with why abandoning it is "
+                f"safe)"))
+        return out
+
+    @staticmethod
+    def _stop_wired(target: FuncInfo) -> bool:
+        """Does the entry point's own body poll a stop/abort signal?"""
+        for call in iter_own_calls(target.node):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            recv = (dotted(call.func.value) or "").lower()
+            if call.func.attr in ("wait", "is_set") \
+                    and ("stop" in recv or "abort" in recv):
+                return True
+        return False
+
+    @staticmethod
+    def _scope_joins(project: Project, spawn) -> bool:
+        """Is a bare ``.join()`` (0-1 args — Thread.join, not
+        str.join) called anywhere in the spawning class (or, for a
+        module-level/function spawn, the module)?"""
+        spawner = spawn.spawner
+        cls = spawner.class_name if spawner else None
+        for fi in project.functions:
+            if fi.module is not spawn.module:
+                continue
+            if cls is not None and fi.class_name != cls:
+                continue
+            for call in iter_own_calls(fi.node):
+                if not (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "join"):
+                    continue
+                # Thread.join takes no args or a numeric timeout;
+                # str.join takes exactly one iterable — a non-numeric
+                # argument (or a str-literal receiver) is string work
+                if isinstance(call.func.value, ast.Constant):
+                    continue
+                if not call.args or (
+                        len(call.args) == 1
+                        and isinstance(call.args[0], ast.Constant)
+                        and isinstance(call.args[0].value, (int, float))):
+                    return True
+        return False
+
+
+# ----------------------------------------------------------- scope-discipline
+
+class ScopeDisciplineRule(Rule):
+    """The ``job.<id>.`` metric namespace belongs to
+    ``metrics.job_scope`` / ``metrics.clear_job``: a hand-built
+    ``job.`` name written through ``inc``/``set_gauge``/``add_time``/
+    ``set_scope``/``clear`` bypasses the thread-local scoping that
+    keeps concurrent service jobs' metrics disjoint (and silently
+    collides with a real job id).  Reads are exempt — aggregators pass
+    the scope string around legitimately."""
+
+    name = "scope-discipline"
+    WRITERS = {"inc", "set_gauge", "add_time", "set_scope", "clear"}
+    PREFIX = "job."
+
+    def applies(self, rel: str) -> bool:
+        return (rel.startswith("racon_tpu/") and rel.endswith(".py")
+                and rel != "racon_tpu/obs/metrics.py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if last_segment(dotted(node.func)) not in self.WRITERS:
+                continue
+            arg = node.args[0]
+            if self._literal_job_name(arg):
+                out.append(self.finding(
+                    module, node,
+                    f"metric write names the `{self.PREFIX}` scope by "
+                    f"hand — build job-scoped names with "
+                    f"metrics.job_scope(...) (and drop them with "
+                    f"metrics.clear_job), never with literals"))
+        return out
+
+    @classmethod
+    def _literal_job_name(cls, arg: ast.AST) -> bool:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value.startswith(cls.PREFIX)
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            head = arg.values[0]
+            return (isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    and head.value.startswith(cls.PREFIX))
+        return False
+
+
+CONCURRENCY_RULES = [LockDisciplineRule(), BlockingUnderLockRule(),
+                     AtomicWriteDisciplineRule(), ThreadLifecycleRule(),
+                     ScopeDisciplineRule()]

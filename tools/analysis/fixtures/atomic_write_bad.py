@@ -1,0 +1,14 @@
+"""Seeded atomic-write-discipline violations: raw write-mode opens
+that can leave a torn artifact for a resume to trust."""
+
+import json
+
+
+def save_manifest_raw(path, manifest):
+    with open(path, "w") as f:         # torn on crash mid-dump
+        json.dump(manifest, f)
+
+
+def append_log(path, line):
+    with open(path, "ab") as f:        # raw append, no fsync/rename
+        f.write(line)

@@ -1,0 +1,19 @@
+"""Clean twin: the tmp -> fsync -> atomic-rename protocol is
+allowlisted, and the one deliberately raw scratch write carries a
+reasoned pragma."""
+
+import os
+
+
+def atomic_write(path, blob):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def scratch(path, blob):
+    with open(path, "wb") as f:  # graftlint: disable=atomic-write-discipline (re-derivable scratch file)
+        f.write(blob)

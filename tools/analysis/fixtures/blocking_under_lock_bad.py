@@ -1,0 +1,22 @@
+"""Seeded blocking-under-lock violations: a sleep, a socket send and a
+transitively-blocking repo callee, all while a named lock is held."""
+
+import os
+import threading
+import time
+
+
+def flush(fd):
+    os.fsync(fd)          # makes flush() transitively blocking
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sock = None
+
+    def push(self, fd, blob):
+        with self._lock:
+            time.sleep(0.1)            # sleep under the lock
+            self.sock.sendall(blob)    # socket send under the lock
+            flush(fd)                  # transitively blocking callee

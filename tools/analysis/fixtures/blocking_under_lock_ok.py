@@ -1,0 +1,29 @@
+"""Clean twin: blocking work happens outside the lock,
+``Condition.wait`` (which releases its lock) is exempt, and the one
+deliberate hold carries a reasoned pragma."""
+
+import os
+import threading
+import time
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.pending = []
+
+    def push(self, sock, blob):
+        with self._lock:
+            self.pending.append(blob)
+        sock.sendall(blob)
+        time.sleep(0)
+
+    def wait_ready(self):
+        with self._cond:
+            self._cond.wait(0.1)
+
+    def checkpoint(self, fd):
+        with self._lock:
+            # graftlint: disable=blocking-under-lock (the lock exists to serialize the checkpoint write)
+            os.fsync(fd)

@@ -1,0 +1,23 @@
+"""Seeded lock-discipline violations: shared attributes written from a
+thread root and the main path with no common guarding lock."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = 0
+        self.results = {}
+
+    def _worker(self):
+        self.jobs += 1              # unguarded thread-side write
+        with self._lock:
+            self.results["x"] = 1   # guarded here...
+
+    def start(self):
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()
+        self.jobs -= 1              # unguarded main-path write
+        self.results["y"] = 2       # ...unguarded there: no common lock
+        t.join()

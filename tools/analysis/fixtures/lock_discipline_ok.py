@@ -1,0 +1,27 @@
+"""Clean twin: every cross-context write shares one lock (the
+Condition aliases to it), and the single deliberate exception carries a
+reasoned pragma."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.jobs = 0
+        self.last_beat = 0.0
+
+    def _worker(self):
+        with self._cond:
+            self.jobs += 1
+        # graftlint: disable=lock-discipline (monotonic float beat: a torn read only delays the watchdog)
+        self.last_beat = 1.0
+
+    def start(self):
+        t = threading.Thread(target=self._worker, daemon=True)
+        t.start()
+        with self._lock:
+            self.jobs -= 1
+        self.last_beat = 2.0
+        t.join()

@@ -1,0 +1,11 @@
+"""Seeded scope-discipline violations: hand-built ``job.`` metric
+names bypass the thread-local scoping that keeps concurrent service
+jobs' metrics disjoint."""
+
+from racon_tpu.obs import metrics
+
+
+def publish(job_id, n):
+    metrics.set_scope(f"job.{job_id}.")
+    metrics.inc("job.7.windows", n)
+    metrics.clear("job.7.")

@@ -1,0 +1,18 @@
+"""Clean twin: the job namespace only via job_scope/clear_job (reads
+through a scope variable are always fine), plus one pragma'd negative
+probe."""
+
+from racon_tpu.obs import metrics
+
+
+def publish(job_id, n):
+    scope = metrics.job_scope(job_id)
+    metrics.set_scope(scope)
+    metrics.inc("windows", n)
+    metrics.set_scope(None)
+    metrics.clear_job(job_id)
+
+
+def probe():
+    # graftlint: disable=scope-discipline (negative probe: asserts the registry rejects hand-built scopes)
+    metrics.set_gauge("job.0.probe", 1)

@@ -1,0 +1,23 @@
+"""Seeded thread-lifecycle violations: fire-and-forget threads with no
+join and no stop-event wiring."""
+
+import threading
+
+
+def _poll_forever():
+    while True:
+        pass
+
+
+def leak_module_thread():
+    threading.Thread(target=_poll_forever).start()
+
+
+class Daemon:
+    def _run(self):
+        while True:
+            pass
+
+    def spawn(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()

@@ -1,0 +1,28 @@
+"""Clean twin: a stop-event-wired daemon that is also joined, and one
+deliberately abandoned helper with a reasoned pragma."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _tick(self):
+        while not self._stop.wait(0.1):
+            pass
+
+    def start(self):
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()
+
+
+class Igniter:
+    def launch(self, fn):
+        # graftlint: disable=thread-lifecycle (droppable best-effort helper; daemon dies harmlessly at exit)
+        threading.Thread(target=fn, daemon=True).start()

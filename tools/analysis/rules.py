@@ -20,6 +20,11 @@ closure built in :mod:`tools.analysis.astutil`.
 |                       | the per-chunk loops of the engines             |
 | span-discipline       | obs.span(...) used any way other than directly |
 |                       | as a `with` item (manual spans leak open)      |
+
+The concurrency & durability pack (round 15) lives in
+:mod:`tools.analysis.concurrency` and registers below: lock-discipline,
+blocking-under-lock, atomic-write-discipline, thread-lifecycle and
+scope-discipline — 11 rules total.
 """
 
 from __future__ import annotations
@@ -38,9 +43,18 @@ class Finding:
     rel: str
     line: int
     message: str
+    # pragma state, filled by the driver: None = no pragma applied;
+    # a string = the reason of the pragma that suppressed this finding
+    pragma: Optional[str] = None
 
     def __str__(self) -> str:
         return f"{self.rel}:{self.line}: {self.rule}: {self.message}"
+
+    def as_dict(self) -> dict:
+        """The ``--json`` record: rule, path, line, message, pragma
+        state."""
+        return {"rule": self.rule, "path": self.rel, "line": self.line,
+                "message": self.message, "pragma": self.pragma}
 
 
 class Rule:
@@ -485,6 +499,12 @@ class SpanDisciplineRule(Rule):
         return out
 
 
+# imported at the bottom so the concurrency pack can subclass Rule /
+# build Findings without a circular import (both names are bound above
+# by the time this line runs)
+from .concurrency import CONCURRENCY_RULES  # noqa: E402
+
 ALL_RULES = [TracerLeakRule(), SwarGuardRule(), SwallowedExceptionRule(),
-             EnvFlagRegistryRule(), HostSyncRule(), SpanDisciplineRule()]
+             EnvFlagRegistryRule(), HostSyncRule(), SpanDisciplineRule(),
+             *CONCURRENCY_RULES]
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

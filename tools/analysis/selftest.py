@@ -28,6 +28,17 @@ EXPECT = {
     "host_sync_ok.py": ("host-sync-in-hot-loop", 0, 0),
     "span_discipline_bad.py": ("span-discipline", 3, 0),
     "span_discipline_ok.py": ("span-discipline", 0, 1),
+    # the concurrency & durability pack (round 15)
+    "lock_discipline_bad.py": ("lock-discipline", 2, 0),
+    "lock_discipline_ok.py": ("lock-discipline", 0, 1),
+    "blocking_under_lock_bad.py": ("blocking-under-lock", 3, 0),
+    "blocking_under_lock_ok.py": ("blocking-under-lock", 0, 1),
+    "atomic_write_bad.py": ("atomic-write-discipline", 2, 0),
+    "atomic_write_ok.py": ("atomic-write-discipline", 0, 1),
+    "thread_lifecycle_bad.py": ("thread-lifecycle", 2, 0),
+    "thread_lifecycle_ok.py": ("thread-lifecycle", 0, 1),
+    "scope_discipline_bad.py": ("scope-discipline", 3, 0),
+    "scope_discipline_ok.py": ("scope-discipline", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }
