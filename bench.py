@@ -738,7 +738,14 @@ def bench_service():
     byte-identity assert against it.  The acceptance metric:
     ``service_compile_fraction`` — the p50 of per-job measured XLA
     compile seconds over job wall, from job #2 on — must be < 0.1
-    (latency dominated by compute, not compile).  0 disables."""
+    (latency dominated by compute, not compile).  0 disables.
+
+    Recovery leg (round 16): the same warm loop re-runs against a
+    ``--serve-dir`` server to measure the journal's warm-path
+    overhead (asserted < 5% p50 regression), then the server is
+    SIGKILLed with an unfetched job spooled and restarted to measure
+    restart-to-first-result recovery time — the BENCH_r06 crash-safety
+    numbers."""
     import os
     import statistics
     import subprocess
@@ -836,6 +843,108 @@ def bench_service():
             service_cold_oneshot_s=round(cold_s, 2),
             service_speedup_vs_cold=round(cold_s / p50, 2),
             service_identity="byte-identical")
+
+        # ---- recovery leg (round 16): journal overhead + restart time
+        serve_dir = os.path.join(td, "serve_dir")
+        jn = min(n_jobs, 20)
+        log(f"service bench: recovery leg — {jn} jobs against a "
+            f"--serve-dir journaled server...")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu", "--serve", sock,
+             "--serve-dir", serve_dir,
+             "-t", "4", "-c", "1", "--tpualigner-batches", "1"],
+            env=env, stderr=subprocess.DEVNULL)
+        unfetched_job = None
+        try:
+            deadline = time.monotonic() + 300
+            while not os.path.exists(sock):
+                if time.monotonic() > deadline or \
+                        server.poll() is not None:
+                    raise RuntimeError(
+                        "journaled resident server did not start")
+                time.sleep(0.2)
+            jlat = []
+            for k in range(jn):
+                t0 = time.perf_counter()
+                with ServiceClient(sock, timeout_s=3600) as c:
+                    job = c.submit(spec)
+                    assert job.get("ok"), job
+                    header, payload = c.result(job["job"],
+                                               timeout_s=3600)
+                jlat.append(time.perf_counter() - t0)
+                assert header.get("ok") and payload == want
+            # one more job, completed but NOT fetched: the restart must
+            # serve it from the spool without re-polishing
+            with ServiceClient(sock, timeout_s=3600) as c:
+                job = c.submit(spec)
+                assert job.get("ok"), job
+                unfetched_job = job["job"]
+                st = c.status(unfetched_job)
+                poll_deadline = time.monotonic() + 3600
+                while st.get("state") not in ("done", "failed"):
+                    assert time.monotonic() < poll_deadline
+                    time.sleep(0.5)
+                    with ServiceClient(sock, timeout_s=60) as c2:
+                        st = c2.status(unfetched_job)
+                assert st.get("state") == "done", st
+        finally:
+            server.kill()  # SIGKILL: the crash the journal exists for
+            server.wait()
+        p50_journal = statistics.median(sorted(jlat[1:]))
+        overhead = (p50_journal - p50) / p50 if p50 else 0.0
+        log(f"service bench: journaled warm p50 {p50_journal:.2f}s "
+            f"(overhead {overhead * 100:+.1f}% vs {p50:.2f}s)")
+        # the durability tax on the warm path must stay noise-level
+        # (<5%, with a small absolute floor for sub-second jobs)
+        assert p50_journal <= p50 * 1.05 + 0.05, (
+            f"journal overhead {overhead * 100:.1f}% exceeds the 5% "
+            f"warm-path budget (p50 {p50:.3f}s -> {p50_journal:.3f}s)")
+
+        log("service bench: restarting from the serve-dir "
+            "(recovery time to first result)...")
+        # SIGKILL leaves the socket FILE behind (only a clean shutdown
+        # unlinks it): drop it so the wait below genuinely measures
+        # the restarted server's bind, not client connect-retries
+        # against a stale path
+        try:
+            os.unlink(sock)
+        except FileNotFoundError:
+            pass
+        t_restart = time.perf_counter()
+        server = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu", "--serve", sock,
+             "--serve-dir", serve_dir,
+             "-t", "4", "-c", "1", "--tpualigner-batches", "1"],
+            env=env, stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.monotonic() + 600
+            while not os.path.exists(sock):
+                if time.monotonic() > deadline or \
+                        server.poll() is not None:
+                    raise RuntimeError(
+                        "restarted resident server did not start")
+                time.sleep(0.1)
+            with ServiceClient(sock, timeout_s=3600) as c:
+                header, payload = c.result(unfetched_job,
+                                           timeout_s=3600)
+            recovery_s = time.perf_counter() - t_restart
+            assert header.get("ok"), header
+            assert payload == want, \
+                "recovered result diverged from the one-shot CLI"
+            with ServiceClient(sock, timeout_s=60) as c:
+                c.shutdown()
+            server.wait(timeout=120)
+        finally:
+            if server.poll() is None:
+                server.kill()
+                server.wait()
+        log(f"service bench: restart-to-first-result "
+            f"{recovery_s:.2f}s (spool-served, zero re-polish)")
+        out.update(
+            service_journal_p50_s=round(p50_journal, 3),
+            service_journal_overhead_pct=round(overhead * 100, 2),
+            service_recovery_s=round(recovery_s, 3),
+            service_recovery_identity="byte-identical")
     return out
 
 

@@ -59,9 +59,12 @@ python -m pytest tests/test_faults.py -q
 # the sanitizer armed — the named locks become WitnessedLocks, the
 # lock-order witness records the acquisition graph across every chip-
 # worker/lease-keeper/socket-handler thread (and the soaks' SIGKILLed
-# subprocesses), and any cycle reports at exit
+# subprocesses), and any cycle reports at exit.  Round 16 added the
+# serve kill/restart soak, so the witness also covers the journal
+# (serve.journal) and supervision locks.
 RACON_TPU_SANITIZE=1 python -m pytest tests/test_faults.py \
-  tests/test_serve.py -q -k "chaos or racing or concurrent"
+  tests/test_serve.py tests/test_serve_recovery.py -q \
+  -k "chaos or racing or concurrent"
 # multi-chip execution shard (fail-fast, round 13): the topology/
 # planner/chip-scheduler suite — get_mesh prefix selection,
 # distributed_init idempotence, device-aware planning (LPT over chips
@@ -76,6 +79,14 @@ python -m pytest tests/test_topology.py tests/test_parallel.py -q
 # survival, job-scoped metrics disjointness (the clear_run fix) and
 # the warm-path compile-amortization claim on the device engine
 python -m pytest tests/test_serve.py -q
+# crash-safe serving shard (fail-fast, round 16): the kill-server
+# chaos soak (SIGKILL mid-batch under RACON_TPU_FAULTS=server.kill,
+# restart from the same --serve-dir — byte-identical results, zero
+# duplicate polishing, v5 recovery counts), restart recovery from
+# spool/queue, idempotent double-submit, journal compaction size
+# bound + torn-tail replay, spool-corruption re-queue, slot-death
+# supervision/quarantine, the drain protocol and the retrying client
+python -m pytest tests/test_serve_recovery.py -q
 # observability shard (fail-fast, round 11): trace schema,
 # RACON_TPU_TRACE byte-identity, disabled-span overhead guard,
 # run-report schema validation for CLI and exec runs
@@ -84,7 +95,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
   --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
   --ignore=tests/test_obs.py --ignore=tests/test_faults.py \
-  --ignore=tests/test_serve.py \
+  --ignore=tests/test_serve.py --ignore=tests/test_serve_recovery.py \
   --ignore=tests/test_topology.py --ignore=tests/test_parallel.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime

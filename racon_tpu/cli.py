@@ -136,7 +136,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "output stays byte-identical to a single-shot "
                         "run; independently launched racon processes "
                         "sharing one --shard-dir cooperate the same "
-                        "way (implies the streaming shard runner)")
+                        "way (implies the streaming shard runner; "
+                        "with --serve it instead sizes the resident "
+                        "worker-slot pool)")
     # resident polishing service (racon_tpu.serve): one warm engine
     # pool amortizes the cold XLA compile across every job it ever runs
     p.add_argument("--serve", metavar="SOCK", default=None,
@@ -159,6 +161,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "resident-footprint estimate of running jobs "
                         "stays under SIZE (plain number = MB; K/M/G/T "
                         "suffixes; default RACON_TPU_SERVE_BUDGET)")
+    p.add_argument("--serve-dir", metavar="DIR", default=None,
+                   help="durable directory for --serve (crash-safe "
+                        "serving): every job lifecycle transition is "
+                        "journaled (append-only, fsync'd) and results "
+                        "spool to CRC-verified files, so a server "
+                        "killed mid-batch restarts from the same DIR "
+                        "with no lost or duplicated work — completed "
+                        "jobs serve from the spool, queued/running "
+                        "jobs re-run down the crash ladder "
+                        "(RACON_TPU_SERVE_DIR is the env equivalent; "
+                        "unset = in-memory only)")
     # internal: a spawned cooperating worker — adopts the primary's
     # manifest, claims/polishes shards, emits no merged FASTA
     p.add_argument("--exec-secondary", action="store_true",
@@ -333,6 +346,10 @@ def main(argv=None) -> int:
         from . import ops
         ops.configure_compile_cache(args.compile_cache)
 
+    if args.serve_dir and not args.serve:
+        parser.error("--serve-dir only makes sense with --serve "
+                     "(the shard runner's checkpoint directory is "
+                     "--shard-dir)")
     if args.serve:
         if args.sequences or args.overlaps or args.target_sequences:
             parser.error("--serve takes no positional inputs (jobs "
@@ -353,8 +370,13 @@ def main(argv=None) -> int:
             aligner_batches=max(1, args.tpualigner_batches),
             consensus_batches=max(1, args.tpupoa_batches),
             chips=args.chips,
+            # --workers N in serve mode = N worker slots on the pool
+            # (the chaos soak's "2-slot server"; chips still win when
+            # more chips than workers are present)
+            workers=args.workers if args.workers > 1 else 0,
             budget_bytes=parse_ram(args.serve_budget)
-            if args.serve_budget else 0)
+            if args.serve_budget else 0,
+            serve_dir=args.serve_dir)
         try:
             return server.serve_forever()
         except KeyboardInterrupt:

@@ -83,6 +83,17 @@ def atomic_write(path: str, data: bytes) -> None:
     fsync_dir(os.path.dirname(path) or ".")
 
 
+def append_durable(f, blob: bytes) -> None:
+    """The append half of the durable-write surface: one record blob
+    onto an already-open append-mode binary stream, flushed and fsync'd
+    before return.  The resident service's job journal appends through
+    this — a crash after return can tear at most the NEXT record,
+    never one already acknowledged (replay drops a torn tail)."""
+    f.write(blob)
+    f.flush()
+    os.fsync(f.fileno())
+
+
 def durable_write(path: str, data: bytes, retries: int = 3) -> None:
     """:func:`atomic_write` with a short transient-I/O retry: a blip
     (EINTR, momentary ENOSPC, NFS stall — or an injected

@@ -131,6 +131,11 @@ class _ChipWorker:
         self.engines = None
         self.cpu_engines = None
         self.mesh_engines = None
+        # serve-mode slot supervision: the job this slot is currently
+        # executing (set by the scheduler under its lock, read by the
+        # supervisor when the slot's thread dies so the job can fail
+        # down the ladder instead of staying RUNNING forever)
+        self.current_job = None
 
     def get_engines(self, cpu: bool, mesh: bool = False):
         # the engine caches below are deliberately lock-free: a slot is
@@ -984,13 +989,11 @@ class ShardRunner:
     # ------------------------------------------------------ shard execution
 
     def _backoff_s(self, si: int, k: int) -> float:
-        """Exponential backoff with deterministic jitter: base * 2^k,
-        jittered ±25% by a hash of (worker, shard, attempt) — workers
-        that hit the same transient fault together fan out instead of
-        thundering back in lockstep, and a rerun replays exactly."""
+        """Exponential backoff with deterministic jitter keyed by
+        (worker, shard, attempt) — the shared :func:`faults.backoff_s`
+        formula (the service ladder and retrying client use it too)."""
         base = max(0.0, flags.get_float("RACON_TPU_EXEC_BACKOFF_S"))
-        frac = zlib.crc32(f"{self.worker}:{si}:{k}".encode()) % 1000
-        return base * (2.0 ** k) * (0.75 + frac / 2000.0)
+        return faults.backoff_s(base, k, f"{self.worker}:{si}:{k}")
 
     def _run_shard(self, si: int, shard: List[int], entry: dict,
                    manifest: dict, beat, claim,

@@ -29,9 +29,16 @@ tests (and for operators reproducing a production fault). The grammar::
   consensus dispatch, the aligner fetch, the part-file write, the
   manifest write, the worker itself (``worker.kill`` SIGKILLs the
   process — the chaos soak's crash source), ``exec.polish`` (the
-  per-shard polish entry the legacy hook targets), and
-  ``serve.polish`` (the resident polishing service's per-job attempt
-  entry — its ladder tests inject here);
+  per-shard polish entry the legacy hook targets), ``serve.polish``
+  (the resident polishing service's per-job attempt entry — its ladder
+  tests inject here), and the round-16 crash-safe-serving sites:
+  ``serve.journal`` (every journal append), ``serve.socket`` (the
+  client's connect path — retry tests inject here), ``serve.slot``
+  (the worker-slot pickup, OUTSIDE the per-job ladder, so an injected
+  fault kills the slot thread itself — the supervision tests' crash
+  source) and ``server.kill`` (the per-job execution entry after the
+  ``running`` journal record — the kill-restart chaos soak's SIGKILL
+  window);
 - *kind* — ``io`` (transient EIO), ``enospc`` (disk full), ``oom``
   (RESOURCE_EXHAUSTED), ``err`` (deterministic compute fault),
   ``stall`` (:class:`StallError`), ``kill`` (SIGKILL own process);
@@ -58,6 +65,7 @@ import os
 import random
 import signal
 import threading
+import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -124,11 +132,24 @@ def classify(exc: BaseException) -> str:
     return CLASS_COMPUTE
 
 
+def backoff_s(base: float, k: int, token: str) -> float:
+    """THE one backoff formula: ``base * 2^k``, jittered ±25% by a
+    CRC32 hash of ``token`` — contenders that hit the same fault
+    together fan out instead of thundering back in lockstep, and a
+    rerun replays exactly (the jitter is a hash, not a random draw).
+    The shard runner's transient-retry ladder, the resident service's
+    per-job ladder and the retrying ``ServiceClient`` all call this
+    rather than growing a second implementation."""
+    frac = zlib.crc32(token.encode()) % 1000
+    return max(0.0, base) * (2.0 ** k) * (0.75 + frac / 2000.0)
+
+
 # --------------------------------------------------------------- injection
 
 KNOWN_SITES = ("consensus.dispatch", "align.fetch", "part.write",
                "manifest.write", "worker.kill", "exec.polish",
-               "serve.polish")
+               "serve.polish", "serve.journal", "serve.socket",
+               "serve.slot", "server.kill")
 
 _KINDS = ("io", "enospc", "oom", "err", "stall", "kill")
 

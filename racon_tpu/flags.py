@@ -144,7 +144,8 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Seeded site-addressed fault injection: "
          "'site:kind[@N][*][%P],...' — sites consensus.dispatch / "
          "align.fetch / part.write / manifest.write / worker.kill / "
-         "exec.polish / serve.polish; kinds io, enospc, oom, err, "
+         "exec.polish / serve.polish / serve.journal / serve.socket / "
+         "serve.slot / server.kill; kinds io, enospc, oom, err, "
          "stall, kill; @N arms on the Nth hit, '*' keeps firing, %P "
          "fires with seeded probability P (see racon_tpu/faults.py)."),
     Flag("RACON_TPU_FAULTS_SEED", "0", "int",
@@ -188,6 +189,28 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Maximum queued (admitted, not yet running) jobs the "
          "resident service holds before rejecting submissions with "
          "'queue full'."),
+    Flag("RACON_TPU_SERVE_DIR", "", "path",
+         "Durable serve directory (equivalent to the CLI --serve-dir "
+         "flag): the append-only fsync'd job journal and the "
+         "CRC-verified result spool live here, so a server killed "
+         "mid-batch restarts with no lost or duplicated work — "
+         "completed jobs serve from the spool, queued/running jobs "
+         "re-admit down the crash ladder (empty = in-memory only)."),
+    Flag("RACON_TPU_SERVE_DRAIN_S", "600", "float",
+         "Bound on the graceful-drain wait (SIGTERM or the protocol's "
+         "shutdown mode=drain): the server stops admission and "
+         "finishes queued + in-flight jobs, but exits anyway after "
+         "this many seconds (0 = wait forever)."),
+    Flag("RACON_TPU_CLIENT_RETRIES", "5", "int",
+         "Bounded retry budget for ServiceClient / racon --submit: "
+         "failed connects and connections lost mid-job reconnect this "
+         "many times with exponential backoff, resubmitting under the "
+         "same idempotency key so a server restart never duplicates "
+         "compute."),
+    Flag("RACON_TPU_CLIENT_BACKOFF_S", "0.25", "float",
+         "Base of the client reconnect exponential backoff (doubled "
+         "per attempt, deterministic CRC32 jitter added — the shared "
+         "faults.backoff_s formula the exec ladder uses)."),
     # -------------------------------------------------------- tests, bench
     Flag("RACON_TPU_SLOW", "0", "bool",
          "Enable the slow (tier-2) test set."),

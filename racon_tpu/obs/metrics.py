@@ -246,6 +246,27 @@ def device_summary(scope: str = "") -> Dict[str, Dict[str, Number]]:
     return rows
 
 
+def recovery_summary() -> Dict[str, Number]:
+    """The crash-safe-serving counters the run report's ``recovery``
+    section (schema v5) embeds: journal replay/append/compaction
+    volume, jobs restored across a server restart, spool verification
+    outcomes, and slot-supervision churn.  These are SERVER-level
+    facts published unscoped (``serve.*`` / ``slot.*`` are not run
+    prefixes), so a per-job report shows its hosting server's totals
+    — all zeros for plain CLI/exec runs."""
+    return {
+        "recovered_jobs": counter("serve.recovered_jobs"),
+        "requeued_jobs": counter("serve.requeued_jobs"),
+        "served_from_spool": counter("serve.spool_served"),
+        "spool_corrupt": counter("serve.spool_corrupt"),
+        "journal_replayed": counter("serve.journal_replayed"),
+        "journal_records": counter("serve.journal_records"),
+        "journal_compactions": counter("serve.journal_compactions"),
+        "slot_restarts": counter("slot.restarts"),
+        "slot_quarantined": counter("slot.quarantined"),
+    }
+
+
 def peak_rss_bytes() -> int:
     """Lifetime peak RSS of this process (ru_maxrss is KiB on Linux,
     bytes on macOS)."""

@@ -37,7 +37,12 @@ from . import metrics
 # from that job's metric scope (``job.<id>.*``), and "dispatch_fetch"
 # grew "compile_s" (real XLA compile seconds via jax.monitoring — THE
 # number the service exists to amortize)
-SCHEMA_VERSION = 4
+# v5 (round 16): the "recovery" section became required — crash-safe
+# serving counters (journal replay/append/compaction, jobs recovered
+# across a server restart, results served from the CRC-verified spool,
+# slot-supervision restarts/quarantines).  Server-level, unscoped;
+# all zeros for plain CLI/exec runs.
+SCHEMA_VERSION = 5
 
 KINDS = ("cli", "exec", "job")
 
@@ -57,6 +62,7 @@ _TOP = {
     "queue": (dict, True),              # bounded-queue health
     "swallowed": (dict, True),          # fault key -> occurrence count
     "faults": (dict, True),             # fault class/site/lease counts
+    "recovery": (dict, True),           # crash-safe serving counters
     "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
@@ -66,6 +72,11 @@ _TOP = {
 _QUEUE_KEYS = ("depth", "producer_wait_s", "consumer_wait_s", "stall_s")
 _PACK_KEYS = ("pack_efficiency", "pad_fraction", "windows_per_group",
               "groups")
+_RECOVERY_KEYS = ("recovered_jobs", "requeued_jobs",
+                  "served_from_spool", "spool_corrupt",
+                  "journal_replayed", "journal_records",
+                  "journal_compactions", "slot_restarts",
+                  "slot_quarantined")
 
 # per-shard row schema: key -> (accepted types, required)
 _SHARD_ROW = {
@@ -145,6 +156,11 @@ def build_report(kind: str, *, argv: Optional[list] = None,
             **{f"lease.{k}": int(v)
                for k, v in metrics.group(scope + "lease.").items()},
         },
+        # crash-safe serving (round 16): journal replay/compaction,
+        # restart-recovered jobs, spool verification and slot-
+        # supervision counters — server-level, so every kind embeds
+        # the hosting process's totals (zeros outside serve mode)
+        "recovery": metrics.recovery_summary(),
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and
         # the span-timer mirrors (dispatch/fetch per chip). {} on
@@ -211,6 +227,10 @@ def validate_report(rep) -> List[str]:
     for key in _QUEUE_KEYS:
         if not isinstance(rep["queue"].get(key), _NUM):
             errors.append(f"queue[{key!r}] missing or non-numeric")
+    for key in _RECOVERY_KEYS:
+        if not isinstance(rep["recovery"].get(key), _NUM) \
+                or isinstance(rep["recovery"].get(key), bool):
+            errors.append(f"recovery[{key!r}] missing or non-numeric")
     for key in _PACK_KEYS:
         if not isinstance(rep["pack"].get(key), _NUM):
             errors.append(f"pack[{key!r}] missing or non-numeric")

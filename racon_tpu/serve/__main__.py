@@ -2,7 +2,8 @@
 entry for the resident polishing service; equivalent to
 ``racon --serve SOCK [options]`` (the options set the server's engine
 profile: -m/-x/-g/-b, -t, -c, --tpualigner-batches, --chips,
---serve-budget, --compile-cache)."""
+--serve-budget, --compile-cache; ``--serve-dir D`` makes the service
+crash-safe — durable job journal, result spool, restart recovery)."""
 
 import sys
 

@@ -1,10 +1,21 @@
-"""Client side of the resident polishing service: a thin connection
-wrapper plus the ``racon --submit`` entry that streams a job's polished
-FASTA back **byte-identical** to a one-shot CLI run's stdout.
+"""Client side of the resident polishing service: a connection wrapper
+with bounded retry, plus the ``racon --submit`` entry that streams a
+job's polished FASTA back **byte-identical** to a one-shot CLI run's
+stdout.
 
 The client never re-encodes the payload: the server announces
 ``"bytes": N`` and the client copies exactly N raw bytes to the output
 stream — the byte-identity contract is structural, not best-effort.
+
+Robustness (round 16): connects retry with exponential backoff and
+deterministic jitter (the shared :func:`racon_tpu.faults.backoff_s`
+formula — not a second implementation), bounded by
+``RACON_TPU_CLIENT_RETRIES`` × ``RACON_TPU_CLIENT_BACKOFF_S``; and
+:func:`submit_and_stream` survives a server death mid-job by
+reconnecting and resubmitting under the SAME idempotency key — a
+``--serve-dir`` server (restarted by its operator/orchestrator)
+recognizes the key, returns the existing journaled job, and the fetch
+resumes where it left off with zero duplicated compute.
 """
 
 from __future__ import annotations
@@ -12,21 +23,64 @@ from __future__ import annotations
 import os
 import socket
 import sys
+import time
 from typing import Optional, Tuple
 
+from .. import faults, flags
 from . import protocol
 
 
 class ServiceClient:
-    """One connection to a :class:`PolishServer` socket.  Usable as a
+    """One connection to a :class:`PolishServer` socket, established
+    with bounded retry + backoff (a server that is restarting — socket
+    missing or refusing — is retried, not failed).  Usable as a
     context manager; every helper returns the decoded response header
     (and :meth:`result` the payload too)."""
 
-    def __init__(self, socket_path: str, timeout_s: float = 600.0):
-        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self.sock.settimeout(timeout_s)
-        self.sock.connect(socket_path)
-        self.rfile = self.sock.makefile("rb")
+    def __init__(self, socket_path: str, timeout_s: float = 600.0,
+                 retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
+        self.socket_path = socket_path
+        self.timeout_s = timeout_s
+        self.retries = max(0, flags.get_int("RACON_TPU_CLIENT_RETRIES")
+                           if retries is None else retries)
+        self.backoff_base = max(0.0, flags.get_float(
+            "RACON_TPU_CLIENT_BACKOFF_S")
+            if backoff_s is None else backoff_s)
+        self.sock: Optional[socket.socket] = None
+        self.rfile = None
+        self._connect()
+
+    def _connect(self) -> None:
+        last: Optional[BaseException] = None
+        for k in range(self.retries + 1):
+            try:
+                faults.check("serve.socket")
+                sock = socket.socket(socket.AF_UNIX,
+                                     socket.SOCK_STREAM)
+                sock.settimeout(self.timeout_s)
+                sock.connect(self.socket_path)
+            except (OSError, ConnectionError) as e:
+                last = e
+                if k >= self.retries:
+                    break
+                delay = faults.backoff_s(
+                    self.backoff_base, k,
+                    f"{self.socket_path}:{os.getpid()}:{k}")
+                time.sleep(delay)
+                continue
+            self.sock = sock
+            self.rfile = sock.makefile("rb")
+            return
+        raise ConnectionError(
+            f"could not connect to {self.socket_path} after "
+            f"{self.retries + 1} attempt(s): {last}")
+
+    def reconnect(self) -> None:
+        """Drop the (possibly dead) connection and re-establish it with
+        the same retry budget."""
+        self.close()
+        self._connect()
 
     def __enter__(self):
         return self
@@ -36,8 +90,18 @@ class ServiceClient:
         return False
 
     def close(self) -> None:
-        self.rfile.close()
-        self.sock.close()
+        if self.rfile is not None:
+            try:
+                self.rfile.close()
+            except OSError:
+                pass
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.rfile = None
+        self.sock = None
 
     def _roundtrip(self, msg: dict) -> dict:
         protocol.send_msg(self.sock, msg)
@@ -53,8 +117,14 @@ class ServiceClient:
     def stats(self) -> dict:
         return self._roundtrip({"op": "stats"})
 
-    def submit(self, spec: dict) -> dict:
-        return self._roundtrip({"op": "submit", "spec": spec})
+    def submit(self, spec: dict, key: Optional[str] = None) -> dict:
+        """Submit a job; ``key`` is an idempotency key — resubmitting
+        under the same key returns the existing job (``existing`` in
+        the response) instead of duplicating compute."""
+        msg = {"op": "submit", "spec": spec}
+        if key is not None:
+            msg["key"] = key
+        return self._roundtrip(msg)
 
     def status(self, job_id: str) -> dict:
         return self._roundtrip({"op": "status", "job": job_id})
@@ -62,8 +132,10 @@ class ServiceClient:
     def cancel(self, job_id: str) -> dict:
         return self._roundtrip({"op": "cancel", "job": job_id})
 
-    def shutdown(self) -> dict:
-        return self._roundtrip({"op": "shutdown"})
+    def shutdown(self, mode: str = "now") -> dict:
+        """Stop the server; ``mode="drain"`` finishes queued +
+        in-flight jobs and flushes the journal first."""
+        return self._roundtrip({"op": "shutdown", "mode": mode})
 
     def result(self, job_id: str, timeout_s: Optional[float] = None,
                keep: bool = False) -> Tuple[dict, Optional[bytes]]:
@@ -113,35 +185,73 @@ def spec_from_args(args) -> dict:
     }
 
 
+def _eprint(msg: str) -> None:
+    print(f"[racon_tpu::serve] {msg}", file=sys.stderr, flush=True)
+
+
 def submit_and_stream(socket_path: str, spec: dict, out,
                       report_path: Optional[str] = None,
-                      timeout_s: float = 3600.0) -> int:
+                      timeout_s: float = 3600.0,
+                      idempotency_key: Optional[str] = None) -> int:
     """The ``racon --submit`` flow: submit, wait, stream the FASTA to
     ``out``, optionally persist the per-job run report.  Returns the
-    process exit code (0 = polished bytes were streamed)."""
-    with ServiceClient(socket_path, timeout_s=timeout_s) as client:
-        resp = client.submit(spec)
-        if not resp.get("ok"):
-            print(f"[racon_tpu::serve] submission rejected: "
-                  f"{resp.get('error')}", file=sys.stderr)
-            return 1
-        job_id = resp["job"]
-        print(f"[racon_tpu::serve] job {job_id} submitted "
-              f"({resp.get('cost_bytes', 0) >> 20} MB estimated)",
-              file=sys.stderr)
-        header, payload = client.result(job_id, timeout_s=timeout_s)
+    process exit code (0 = polished bytes were streamed).
+
+    Crash-safe against the SERVER dying mid-job: every submission
+    carries an idempotency key (auto-generated unless supplied), and a
+    connection lost at any point reconnects with backoff and
+    resubmits under the same key — a restarted ``--serve-dir`` server
+    returns the existing journaled job (recovered result included),
+    so the retry never duplicates compute and the streamed bytes stay
+    identical.  Admission rejections are NOT retried (they are
+    deterministic answers, not faults)."""
+    key = idempotency_key or (
+        f"{socket.gethostname()}:{os.getpid()}:{time.monotonic_ns()}")
+    retries = max(0, flags.get_int("RACON_TPU_CLIENT_RETRIES"))
+    base = max(0.0, flags.get_float("RACON_TPU_CLIENT_BACKOFF_S"))
+    attempt = 0
+    while True:
+        try:
+            with ServiceClient(socket_path,
+                               timeout_s=timeout_s) as client:
+                resp = client.submit(spec, key=key)
+                if not resp.get("ok"):
+                    _eprint(f"submission rejected: {resp.get('error')}")
+                    return 1
+                job_id = resp["job"]
+                if resp.get("existing"):
+                    _eprint(f"job {job_id} already journaled under "
+                            f"this key — resuming it")
+                else:
+                    _eprint(f"job {job_id} submitted "
+                            f"({resp.get('cost_bytes', 0) >> 20} MB "
+                            f"estimated)")
+                header, payload = client.result(job_id,
+                                                timeout_s=timeout_s)
+        except (OSError, ConnectionError) as e:
+            attempt += 1
+            if attempt > retries:
+                _eprint(f"giving up after {retries} reconnect "
+                        f"attempt(s): {e}")
+                return 1
+            delay = faults.backoff_s(base, attempt - 1,
+                                     f"{key}:{attempt}")
+            _eprint(f"connection lost ({e}) — reconnecting in "
+                    f"{delay:.2f}s (attempt {attempt}/{retries}; the "
+                    f"idempotency key resumes the same job)")
+            time.sleep(delay)
+            continue
+        break
     if report_path and header.get("report"):
         from ..obs import report as obs_report
         obs_report.write_report(report_path, header["report"])
     if payload is None:
-        print(f"[racon_tpu::serve] job {job_id} "
-              f"{header.get('state')}: {header.get('error')}",
-              file=sys.stderr)
+        _eprint(f"job {job_id} {header.get('state')}: "
+                f"{header.get('error')}")
         return 1
     out.write(payload)
     out.flush()
-    print(f"[racon_tpu::serve] job {job_id} done in "
-          f"{header.get('wall_s', 0.0):.2f}s "
-          f"(compile {header.get('compile_s', 0.0):.2f}s, "
-          f"engine={header.get('engine', '-')})", file=sys.stderr)
+    _eprint(f"job {job_id} done in {header.get('wall_s', 0.0):.2f}s "
+            f"(compile {header.get('compile_s', 0.0):.2f}s, "
+            f"engine={header.get('engine', '-')})")
     return 0

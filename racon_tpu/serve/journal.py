@@ -1,0 +1,331 @@
+"""Durable job journal + result spool for the resident polishing
+service (``racon --serve SOCK --serve-dir D``).
+
+Round 14 made polishing resident; this module (round 16) makes it
+*crash-safe*: every job lifecycle transition is journaled to an
+append-only, per-record-fsync'd file, and result payloads are spooled
+to CRC32-verified files instead of held in server RAM — so a server
+OOM, preemption or SIGKILL loses nothing, and a restart from the same
+``--serve-dir`` replays the journal and picks every job back up
+(:meth:`racon_tpu.serve.service.PolishServer._recover`).
+
+Serve-dir layout::
+
+    D/
+      journal.jsonl      # append-only, one JSON record per line
+      spool/             # result payloads: result_<job>.fasta
+
+Record grammar (``rec`` selects; every record carries ``job``):
+
+- ``submitted`` — ``{job, key, cost, unix, spec}``: admitted into the
+  queue (``key`` is the client's idempotency key, if any);
+- ``running`` — ``{job, worker, run}``: an execution incarnation
+  began.  The COUNT of these per job is the crash ladder's input: a
+  job whose journal shows N running records and no terminal record
+  died N times with the server, and recovery walks it down the same
+  degradation ladder the round-12 exec layer uses (retry → CPU
+  engines → fail) instead of an infinite redo loop;
+- ``done`` — ``{job, bytes, crc32, spool, wall_s, engine}``: the
+  payload is in the spool (size + CRC recorded here, verified on
+  every post-restart fetch);
+- ``failed`` / ``cancelled`` — terminal without a payload;
+- ``collected`` — the one-fetch payload was streamed to a client; the
+  job is fully retired and the next compaction drops its records (and
+  its spool file).
+
+Durability protocol: appends go through the shared
+:func:`racon_tpu.exec.manifest.append_durable` (write + flush + fsync
+per record), spool files and compaction rewrites go through the shared
+:func:`racon_tpu.obs.report.atomic_write_bytes` tmp → fsync → rename
+protocol plus a directory fsync — the exact crash-ordering contract
+the exec manifest established.  A torn tail line (the crash happened
+mid-append) is dropped on replay; anything before it is complete by
+the fsync ordering.
+
+**Compaction** keeps a long-lived server's serve-dir bounded: on every
+startup (after replay) and every :attr:`JobJournal.compact_every`
+appended records, the journal is atomically rewritten to live-jobs-only
+records — live means queued, running, or done-but-uncollected; fully
+retired jobs (collected, or terminal without a payload owed) drop out,
+along with orphaned spool files and ``*.tmp.*`` litter from crashed
+writes (the ``_clean_work_dir`` sweep, re-homed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+from .. import faults, sanitize
+from ..exec import manifest as mf
+from ..obs import metrics
+from ..obs.report import atomic_write_bytes
+from ..utils.logger import log_swallowed, warn
+
+JOURNAL_NAME = "journal.jsonl"
+SPOOL_DIR = "spool"
+
+# record types (the "rec" field)
+SUBMITTED = "submitted"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+COLLECTED = "collected"
+
+
+class JobJournal:
+    """The serve-dir's journal + spool, behind one named lock
+    (``serve.journal`` — under ``RACON_TPU_SANITIZE=1`` it feeds the
+    round-15 lock-order witness together with the scheduler locks)."""
+
+    # appended records between automatic compactions (class attribute:
+    # the size-bound test shrinks it)
+    compact_every = 256
+
+    def __init__(self, serve_dir: str):
+        self.serve_dir = os.path.abspath(serve_dir)
+        self.spool_dir = os.path.join(self.serve_dir, SPOOL_DIR)
+        os.makedirs(self.spool_dir, exist_ok=True)
+        self.path = os.path.join(self.serve_dir, JOURNAL_NAME)
+        self.lock = sanitize.named_lock("serve.journal")
+        self._f = None
+        self._closed = False
+        self.appends_since_rewrite = 0
+        self.sweep_tmp()
+
+    # ------------------------------------------------------------ hygiene
+
+    def sweep_tmp(self) -> int:
+        """Drop ``*.tmp.*`` litter left by atomic writes that crashed
+        between create and rename (their monotonic-ns names are never
+        reused, so a crash-restarted serve-dir would otherwise collect
+        them forever — the ``_clean_work_dir`` rule, re-homed)."""
+        swept = 0
+        for d in (self.serve_dir, self.spool_dir):
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if ".tmp" not in name:
+                    continue
+                try:
+                    os.unlink(os.path.join(d, name))
+                    swept += 1
+                except OSError as e:
+                    log_swallowed("serve: tmp-litter sweep failed", e)
+        return swept
+
+    # ------------------------------------------------------------- append
+
+    def _handle(self):
+        if self._f is None:
+            # fsync'd-append protocol: the handle stays open for the
+            # journal's life; every append() flushes + fsyncs through
+            # mf.append_durable before returning.  Every _f write site
+            # (here, rewrite_locked, close) runs with self.lock held
+            # by its caller — the guard is interprocedural.
+            # graftlint: disable=lock-discipline (every caller holds self.lock; the guard is interprocedural)
+            self._f = open(self.path, "ab")
+        return self._f
+
+    def _truncate_to_locked(self, pos: int) -> None:
+        """Roll a failed append back to the pre-write offset (caller
+        holds the lock): a write/flush that raised may have landed
+        SOME bytes, and retrying on top of them would weld a torn
+        prefix onto the retried record — one corrupt line that halts
+        replay for every later job.  The handle is discarded (its
+        buffer may hold the partial record) and the file truncated."""
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError as e:
+                log_swallowed("serve: failed-append handle close", e)
+            self._f = None
+        try:
+            with open(self.path, "ab") as f:
+                f.truncate(pos)
+        except OSError as e:
+            log_swallowed("serve: journal rollback truncate failed "
+                          "(replay drops the torn line)", e)
+
+    def append(self, rec: dict, retries: int = 3) -> None:
+        """Durably append one lifecycle record (fsync'd before return),
+        with the same transient-I/O retry ``manifest.durable_write``
+        gives checkpoint writes — a blip on a *journal* write must not
+        kill a server whose actual work succeeded.  A failed attempt
+        rolls the file back to its pre-append size first, so a retry
+        can never produce a torn-then-duplicate record."""
+        blob = json.dumps(rec, separators=(",", ":")).encode() + b"\n"
+        delay = 0.05
+        for k in range(retries + 1):
+            try:
+                with self.lock:
+                    if self._closed:
+                        return
+                    faults.check("serve.journal")
+                    f = self._handle()
+                    # prior appends always flushed+fsync'd, so st_size
+                    # IS the logical end — the rollback point
+                    pos = os.fstat(f.fileno()).st_size
+                    try:
+                        # fsync-under-lock is the POINT of this lock: a
+                        # record must hit disk before another thread's
+                        # record (or a compaction rewrite) interleaves
+                        # graftlint: disable=blocking-under-lock (the lock exists to serialize fsync'd appends against compaction)
+                        mf.append_durable(f, blob)
+                    except OSError:
+                        self._truncate_to_locked(pos)
+                        raise
+                    self.appends_since_rewrite += 1
+                metrics.inc("serve.journal_records")
+                return
+            except OSError as e:
+                if k >= retries or \
+                        faults.classify(e) != faults.CLASS_TRANSIENT:
+                    raise
+                warn(f"transient fault appending to the job journal "
+                     f"({e}) — retrying in {delay:.2f}s")
+                time.sleep(delay)
+                delay *= 2
+
+    # ------------------------------------------------------------- replay
+
+    def replay(self) -> List[dict]:
+        """Every complete record, in append order.  A torn/corrupt line
+        ends the replay there: per-record fsync guarantees everything
+        BEFORE a torn tail is complete, and a mid-file corruption means
+        the disk lied — later records' ordering cannot be trusted, and
+        correct-over-salvaged wins (the affected jobs simply re-run)."""
+        out: List[dict] = []
+        try:
+            with open(self.path, "rb") as f:
+                lines = f.read().split(b"\n")
+        except FileNotFoundError:
+            return out
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                warn(f"job journal line {i + 1} is torn/corrupt — "
+                     f"replay stops there (jobs past it re-run)")
+                break
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    # --------------------------------------------------------- compaction
+
+    def rewrite_locked(self, records: List[dict]) -> None:
+        """Compaction core — caller holds :attr:`lock` (the server
+        snapshots its live jobs and rewrites under ONE hold, so no
+        append can slip between snapshot and rewrite and be lost):
+        atomically replace the journal with the given live-jobs-only
+        records (tmp → fsync → rename + directory fsync)."""
+        blob = b"".join(
+            json.dumps(r, separators=(",", ":")).encode() + b"\n"
+            for r in records)
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        # the rename must land before appends resume — same
+        # serialize-the-durable-write rationale as append()
+        atomic_write_bytes(self.path, blob)
+        mf.fsync_dir(self.serve_dir)
+        # graftlint: disable=lock-discipline (every caller holds self.lock; the guard is interprocedural)
+        self.appends_since_rewrite = 0
+        metrics.inc("serve.journal_compactions")
+
+    def rewrite(self, records: List[dict]) -> None:
+        """:meth:`rewrite_locked` under the journal lock (the
+        standalone-compaction entry tests use)."""
+        with self.lock:
+            # graftlint: disable=blocking-under-lock (compaction rewrite must not interleave with appends)
+            self.rewrite_locked(records)
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    # -------------------------------------------------------------- spool
+
+    def spool_name(self, job_id: str) -> str:
+        return f"result_{job_id}.fasta"
+
+    def spool_path(self, job_id: str) -> str:
+        return os.path.join(self.spool_dir, self.spool_name(job_id))
+
+    def spool_write(self, job_id: str, blob: bytes) \
+            -> Tuple[str, int, int]:
+        """Durably spool one result payload (atomic write); returns
+        ``(spool name, byte size, crc32)`` for the ``done`` record the
+        fetch path verifies against."""
+        crc = zlib.crc32(blob)
+        atomic_write_bytes(self.spool_path(job_id), blob)
+        mf.fsync_dir(self.spool_dir)
+        return self.spool_name(job_id), len(blob), crc
+
+    def spool_read(self, job_id: str, size: int,
+                   crc32: int) -> Optional[bytes]:
+        """The spooled payload, verified against its recorded size and
+        CRC32 — None when missing/truncated/corrupt (the caller
+        re-queues the job, mirroring the exec part-verification
+        pass)."""
+        try:
+            with open(self.spool_path(job_id), "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        if len(blob) != size or zlib.crc32(blob) != crc32:
+            warn(f"result spool for job {job_id} failed verification "
+                 f"({len(blob)}B vs recorded {size}B) — treating the "
+                 f"result as lost")
+            return None
+        return blob
+
+    def spool_unlink(self, job_id: str) -> None:
+        try:
+            os.unlink(self.spool_path(job_id))
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            log_swallowed("serve: spool unlink failed", e)
+
+    def sweep_spool(self, keep_jobs) -> int:
+        """Unlink spool files whose job is not in ``keep_jobs`` —
+        orphans of collected/compacted jobs (run with compaction)."""
+        keep = {self.spool_name(j) for j in keep_jobs}
+        swept = 0
+        try:
+            names = os.listdir(self.spool_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if name in keep or not name.startswith("result_"):
+                continue
+            try:
+                os.unlink(os.path.join(self.spool_dir, name))
+                swept += 1
+            except OSError as e:
+                log_swallowed("serve: orphan spool sweep failed", e)
+        return swept
+
+    # -------------------------------------------------------------- close
+
+    def close(self) -> None:
+        with self.lock:
+            self._closed = True
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError as e:
+                    log_swallowed("serve: journal close failed", e)
+                self._f = None

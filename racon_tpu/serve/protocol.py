@@ -13,20 +13,32 @@ stdout.
 
 Requests (``op`` selects):
 
-- ``ping`` — liveness; response echoes server identity and uptime.
+- ``ping`` — liveness; response echoes server identity, uptime,
+  healthy worker count, serve-dir and drain state.
 - ``submit`` — a job spec (input paths + polishing options, see
-  :data:`SPEC_KEYS`); response carries the job id, or ``ok: false``
+  :data:`SPEC_KEYS`) plus an optional top-level ``key`` (client
+  idempotency key): a resubmission under an already-journaled key
+  returns the EXISTING job (``"existing": true``) instead of
+  duplicating compute — the hook the retrying client uses to survive
+  a server restart.  Response carries the job id, or ``ok: false``
   with the admission-rejection reason.
 - ``status`` — one job's state (queued/running/done/failed/cancelled),
   queue position, cost estimate, ladder attempts so far.
 - ``result`` — blocks (bounded by ``timeout_s``) until the job is
   terminal, then returns the header + FASTA payload (and the per-job
-  ``run_report`` alongside).
+  ``run_report`` alongside).  With ``--serve-dir`` the payload streams
+  from the CRC-verified result spool, so it survives a server restart
+  until one successful fetch.
 - ``cancel`` — cancels a QUEUED job; a running job cannot be safely
   interrupted mid-dispatch and the response says so.
 - ``stats`` — server-level counters (jobs done/failed, in-flight
-  footprint, queue depth).
-- ``shutdown`` — stop accepting, finish the running jobs, exit.
+  footprint, queue depth, slot quarantine/restart and journal
+  recovery counters).
+- ``shutdown`` — ``{"mode": "now"}`` (default) stops accepting and
+  lets running jobs finish; ``{"mode": "drain"}`` additionally waits
+  for the QUEUE to empty (bounded by ``RACON_TPU_SERVE_DRAIN_S``) and
+  flushes/compacts the job journal before exit — the same protocol a
+  ``SIGTERM`` triggers.
 
 Paths in a job spec are server-local: the socket is unix-domain, so
 client and server share a filesystem by construction.

@@ -47,11 +47,25 @@ Architecture (every piece is an existing subsystem, re-hosted):
   alongside the result, and real XLA compile seconds are attributed
   per job via a ``jax.monitoring`` duration listener — the
   ``service_compile_fraction`` number the ROADMAP item is scored on.
+- **Crash safety** (round 16, ``--serve-dir``) — every lifecycle
+  transition is journaled durably (:mod:`racon_tpu.serve.journal`),
+  results spool to CRC-verified files instead of RAM, a restart from
+  the same serve-dir replays the journal (completed jobs serve from
+  the spool, queued/running jobs re-admit down the round-12 crash
+  ladder, client idempotency keys dedupe resubmissions), worker slots
+  are *supervised* (a dead/wedged slot thread fails its job down the
+  per-job ladder and is restarted with fresh engines; repeated deaths
+  quarantine the slot and shrink advertised capacity), and
+  ``SIGTERM``/``shutdown {"mode": "drain"}`` stops admission, finishes
+  in-flight jobs and flushes the journal before exit.  The run-report
+  schema grew a ``recovery`` section (v5) carrying the journal
+  replay/compaction and slot-supervision counters.
 """
 
 from __future__ import annotations
 
 import os
+import signal as signal_mod
 import socket
 import sys
 import threading
@@ -69,6 +83,7 @@ from ..obs import metrics, report as obs_report
 from ..parallel.topology import ChipSlot
 from ..utils.logger import log_swallowed, warn
 from . import protocol
+from .journal import JobJournal
 
 # job states
 QUEUED = "queued"
@@ -81,6 +96,16 @@ _TERMINAL = (DONE, FAILED, CANCELLED)
 
 # default client-side wait bound for a blocking result request
 DEFAULT_RESULT_TIMEOUT_S = 3600.0
+
+# the per-job crash ladder (server death / slot death both count):
+# crash 1 -> re-run on the primary engines (could have been unlucky),
+# crash 2 -> re-run on the CPU engines, crash 3 -> fail-with-reason —
+# the round-12 degradation shape, never an infinite redo loop
+_MAX_JOB_CRASHES = 3
+# slot supervision: consecutive deaths before a slot is quarantined
+# instead of restarted (advertised capacity shrinks with it)
+_SLOT_QUARANTINE_DEATHS = 3
+_SUPERVISE_POLL_S = 0.5
 
 
 def _eprint(msg: str) -> None:
@@ -170,6 +195,21 @@ class Job:
         self.wall_s = 0.0
         self.compile_s = 0.0
         self.done = threading.Event()
+        # crash-safe serving (round 16): the client's idempotency key,
+        # the spooled-result coordinates (name + CRC the fetch path
+        # verifies), how many `running` journal records exist for this
+        # job, and how many times it died with its executor (server
+        # crash or slot death) — the ladder input
+        self.key: Optional[str] = None
+        self.spool: Optional[str] = None
+        self.crc32 = 0
+        self.journal_runs = 0
+        self.crash_count = 0
+        self.recovered = False
+        # answered FAILED in RAM by a hard stop, but still journaled
+        # `submitted` on disk: the final compaction must keep it live
+        # so the restarted server runs it
+        self.shutdown_orphan = False
 
     def row(self) -> dict:
         """The protocol's status view of this job."""
@@ -213,7 +253,8 @@ class PolishServer:
                  aligner_batches: int = 1, consensus_batches: int = 1,
                  chips: int = 0, workers: int = 0,
                  budget_bytes: int = 0, max_queue: int = 0,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 serve_dir: Optional[str] = None):
         self.socket_path = os.path.abspath(socket_path)
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.banded = banded
@@ -257,6 +298,21 @@ class PolishServer:
         self._conn_threads: List[threading.Thread] = []
         self._t0 = time.perf_counter()
         self.started = threading.Event()       # listener bound + warm kick
+        # crash-safe serving (round 16): the durable job journal +
+        # result spool (None = the pre-round-16 in-memory service),
+        # the idempotency-key index, the drain flag, and the slot-
+        # supervision state (per-ordinal thread/death bookkeeping)
+        serve_dir = serve_dir or \
+            flags.get_str("RACON_TPU_SERVE_DIR").strip() or None
+        self.serve_dir = os.path.abspath(serve_dir) if serve_dir else None
+        self._journal: Optional[JobJournal] = \
+            JobJournal(self.serve_dir) if self.serve_dir else None
+        self._by_key: Dict[str, str] = {}
+        self._draining = False
+        self._slot_threads: Dict[int, threading.Thread] = {}
+        self._slot_deaths: Dict[int, int] = {}
+        self._quarantined: set = set()
+        self._supervisor: Optional[threading.Thread] = None
 
     # ------------------------------------------------------- engine pool
 
@@ -343,27 +399,53 @@ class PolishServer:
 
     # --------------------------------------------------------- admission
 
-    def _admit(self, raw_spec: dict) -> Tuple[Optional[Job], Optional[str]]:
+    def _admit(self, raw_spec: dict, key: Optional[str] = None) \
+            -> Tuple[Optional[Job], Optional[str], bool]:
         """Admission control: validate the spec, check it against the
         resident engine profile, estimate its footprint with the exec
         planner's cost model, and bound queue depth + total footprint.
-        Returns ``(job, None)`` or ``(None, rejection reason)`` — the
-        reject-with-reason contract that replaces a silent OOM."""
+        Returns ``(job, None, existing)`` or ``(None, rejection
+        reason, False)`` — the reject-with-reason contract that
+        replaces a silent OOM.  ``key`` is the client's idempotency
+        key: a resubmission of an already-journaled spec returns the
+        EXISTING job (``existing=True``) instead of duplicating
+        compute — the contract that makes client reconnect-and-refetch
+        across a server restart safe."""
+        if key:
+            with self._lock:
+                jid = self._by_key.get(key)
+                prior = self._jobs.get(jid) if jid else None
+            # a FAILED prior is retryable — a fresh submission under
+            # the same key admits a new attempt; queued/running/done
+            # work is never duplicated
+            if prior is not None and prior.state != FAILED:
+                return prior, None, True
+        if self._draining:
+            return None, (
+                "server is draining (SIGTERM / shutdown mode=drain): "
+                "admission is stopped — resubmit to the restarted "
+                "server (your idempotency key keeps it safe)"), False
+        if self._quarantined and self.healthy_workers() == 0:
+            return None, (
+                "every worker slot is quarantined after repeated "
+                "deaths — the server has no healthy capacity left; "
+                "restart it (a --serve-dir server recovers its queue "
+                "on restart)"), False
         spec, err = protocol.normalize_spec(raw_spec)
         if err is not None:
-            return None, err
-        for key in protocol.SPEC_PATHS:
-            spec[key] = os.path.abspath(spec[key])
-            if not os.path.isfile(spec[key]):
-                return None, f"input not found: {spec[key]}"
+            return None, err, False
+        for pkey in protocol.SPEC_PATHS:
+            spec[pkey] = os.path.abspath(spec[pkey])
+            if not os.path.isfile(spec[pkey]):
+                return None, f"input not found: {spec[pkey]}", False
         for path, kind in ((spec["sequences"], "sequences"),
                            (spec["target_sequences"], "target")):
             if parsers.sequence_parser_for(path) is None:
                 return None, (f"{kind} file {path} has an unsupported "
-                              f"format extension")
+                              f"format extension"), False
         if parsers.overlap_parser_for(spec["overlaps"]) is None:
             return None, (f"overlaps file {spec['overlaps']} has an "
-                          f"unsupported format extension")
+                          f"unsupported format extension"), False
         profile = (self.match, self.mismatch, self.gap, self.banded)
         requested = (spec["match"], spec["mismatch"], spec["gap"],
                      spec["banded"])
@@ -373,7 +455,7 @@ class PolishServer:
                 f"compiled for (match, mismatch, gap, banded) = "
                 f"{profile}, the job asked for {requested} — submit to "
                 f"a server started with those scores, or restart this "
-                f"one with them")
+                f"one with them"), False
         cost = estimate_job_cost(spec["sequences"], spec["overlaps"],
                                  spec["target_sequences"])
         if cost > self.budget_bytes:
@@ -382,21 +464,62 @@ class PolishServer:
                 f"service budget {self.budget_bytes >> 20} MB "
                 f"(--serve-budget / RACON_TPU_SERVE_BUDGET) — run it "
                 f"one-shot through the streaming shard runner "
-                f"(--max-ram) instead")
+                f"(--max-ram) instead"), False
         with self._cond:
             if len(self._queue) >= self.max_queue:
                 return None, (
                     f"queue full ({self.max_queue} jobs waiting; "
-                    f"RACON_TPU_SERVE_QUEUE raises the bound)")
+                    f"RACON_TPU_SERVE_QUEUE raises the bound)"), False
+            if key and key in self._by_key:
+                # a racing duplicate landed between the fast-path check
+                # and here: the first submission wins, same contract
+                prior = self._jobs.get(self._by_key[key])
+                if prior is not None and prior.state != FAILED:
+                    return prior, None, True
             self._next_id += 1
             job = Job(f"j{self._next_id}", spec, cost)
+            job.key = key or None
+            # registered (and key-indexed) BEFORE it is runnable, so a
+            # duplicate submit dedupes while we journal below
             self._jobs[job.id] = job
+            if job.key:
+                self._by_key[job.key] = job.id
+        if self._journal is not None:
+            # the write-ahead half of admission: the `submitted` record
+            # must be durable BEFORE the job can run (a `running`
+            # record must never precede its `submitted`); a journal
+            # that cannot record the job means the job is not admitted
+            try:
+                self._journal.append({
+                    "rec": "submitted", "job": job.id, "key": job.key,
+                    "cost": cost, "unix": round(job.submitted_unix, 3),
+                    "spec": spec})
+            # graftlint: disable=swallowed-exception (the failure IS the reply: it becomes the client's rejection reason)
+            except Exception as e:
+                # the job stays registered but FAILED (not popped): a
+                # racing duplicate submission under the same key may
+                # already have been answered with this id, and an id
+                # the server acknowledged must keep resolving.  A
+                # FAILED prior is retryable, so the key is reusable.
+                with self._cond:
+                    job.state = FAILED
+                    job.error = (f"job journal write failed "
+                                 f"({type(e).__name__}: {e})")
+                    self._counts["failed"] = \
+                        self._counts.get("failed", 0) + 1
+                    self._retired.append(job.id)
+                    job.done.set()
+                return None, (f"job journal write failed "
+                              f"({type(e).__name__}: {e}) — the "
+                              f"serve-dir is not accepting durable "
+                              f"admissions"), False
+        with self._cond:
             self._queue.append(job)
             self._counts["submitted"] += 1
             self._cond.notify_all()
         # outside the lock: warm-up geometry derivation stats files
         self._warm_job_geometry(spec)
-        return job, None
+        return job, None, False
 
     # ------------------------------------------------------ job execution
 
@@ -423,6 +546,10 @@ class PolishServer:
                         job.worker = worker.worker
                         job.started_at = time.perf_counter()
                         self._running_cost += job.cost
+                        # supervision handle: if this slot's thread
+                        # dies, the supervisor finds the orphaned job
+                        # here and walks it down the crash ladder
+                        worker.current_job = job
                         return job
                 self._cond.wait(0.2)
 
@@ -431,6 +558,11 @@ class PolishServer:
             job = self._next_job(worker)
             if job is None:
                 return
+            # slot-supervision chaos site: an injected fault HERE is
+            # OUTSIDE the per-job ladder and kills the slot thread
+            # itself — exactly the death the supervisor must detect,
+            # requeue the job from, and restart the slot after
+            faults.check("serve.slot")
             try:
                 self._run_job(worker, job)
             except Exception as e:
@@ -443,6 +575,7 @@ class PolishServer:
             finally:
                 with self._cond:
                     self._running_cost -= job.cost
+                    worker.current_job = None
                     self._counts[job.state] = \
                         self._counts.get(job.state, 0) + 1
                     self._retired.append(job.id)
@@ -452,7 +585,9 @@ class PolishServer:
                         if old is not None:
                             old.result = None  # drop a never-fetched blob
                     self._cond.notify_all()
+                self._journal_terminal(job)
                 job.done.set()
+            self._maybe_compact()
             _eprint(f"job {job.id} {job.state} in {job.wall_s:.2f}s "
                     f"(engine={job.engine or '-'}, "
                     f"compile {job.compile_s:.2f}s, "
@@ -486,13 +621,28 @@ class PolishServer:
         round-12 degradation ladder on failure — the server survives
         every rung, and the ladder record rides in the job's status,
         result and report."""
+        if self._journal is not None:
+            # write-ahead: the incarnation is journaled BEFORE any
+            # compute, so a crash from here on leaves a countable
+            # `running` record — the crash ladder's input on replay
+            job.journal_runs += 1
+            self._journal.append({"rec": "running", "job": job.id,
+                                  "worker": worker.worker,
+                                  "run": job.journal_runs})
+        # kill-restart chaos site: a SIGKILL here leaves this job
+        # journaled `running` with no terminal record — exactly the
+        # state restart recovery must re-admit
+        faults.check("server.kill")
         scope = metrics.job_scope(job.id)
         metrics.set_scope(scope)
         t_start = time.time()
         t0 = time.perf_counter()
         max_retries = max(0, flags.get_int("RACON_TPU_EXEC_RETRIES"))
         transient_used = 0
-        tier_cpu = False
+        # a job that already died with its executor re-enters the
+        # ladder where it left off: the second crash lands it on the
+        # CPU engines (a device/engine fault may be what killed it)
+        tier_cpu = job.crash_count >= 2
         blob: Optional[bytes] = None
         try:
             for attempt_no in range(64):  # ladder is finite
@@ -510,9 +660,11 @@ class PolishServer:
                     job.attempts.append(att)
                     if cls == faults.CLASS_TRANSIENT and \
                             transient_used < max_retries:
-                        backoff = (max(0.0, flags.get_float(
-                            "RACON_TPU_EXEC_BACKOFF_S"))
-                            * (2.0 ** transient_used))
+                        backoff = faults.backoff_s(
+                            max(0.0, flags.get_float(
+                                "RACON_TPU_EXEC_BACKOFF_S")),
+                            transient_used,
+                            f"{job.id}:{transient_used}")
                         att["action"] = "retry-backoff"
                         att["backoff_s"] = round(backoff, 3)
                         transient_used += 1
@@ -540,8 +692,16 @@ class PolishServer:
             job.wall_s = time.perf_counter() - t0
             job.compile_s = metrics.timer_s(scope + "compile.jax_s")
             if blob is not None:
-                job.result = blob
-                job.result_bytes = len(blob)
+                if self._journal is not None:
+                    # results spool to CRC-verified files, not RAM:
+                    # the server's memory stays bounded by in-flight
+                    # work and the payload survives a restart
+                    job.spool, job.result_bytes, job.crc32 = \
+                        self._journal.spool_write(job.id, blob)
+                    job.result = None
+                else:
+                    job.result = blob
+                    job.result_bytes = len(blob)
                 job.engine = "cpu-retry" if tier_cpu else "primary"
                 job.state = DONE
             else:
@@ -561,6 +721,326 @@ class PolishServer:
             # dicts without bound (the heartbeat only reads RUNNING
             # jobs' scopes, so nothing still wants these)
             metrics.clear_job(job.id)
+
+    # ----------------------------------------- journal lifecycle + recovery
+
+    def _journal_terminal(self, job: Job) -> None:
+        """Durably record a job's terminal transition.  A failed append
+        here is logged, not raised: losing a ``done`` record only means
+        the job re-runs (byte-identically) after a restart — safe,
+        where a dead worker thread is not."""
+        if self._journal is None or job.state not in (DONE, FAILED):
+            return
+        try:
+            if job.state == DONE:
+                self._journal.append({
+                    "rec": "done", "job": job.id,
+                    "bytes": job.result_bytes, "crc32": job.crc32,
+                    "spool": job.spool,
+                    "wall_s": round(job.wall_s, 3),
+                    "engine": job.engine})
+            else:
+                self._journal.append({"rec": "failed", "job": job.id,
+                                      "error": job.error or ""})
+        except Exception as e:
+            log_swallowed("serve: journal terminal record failed "
+                          "(the job will re-run after a restart)", e)
+
+    def _live_records_locked(self) -> List[dict]:
+        """The live-jobs-only journal a compaction rewrites to: one
+        ``submitted`` record, the job's ``running`` incarnations (the
+        crash ladder's input must survive compaction), and the ``done``
+        record for an uncollected payload.  Fully retired jobs —
+        collected, failed, cancelled — drop out (their client already
+        has the answer; a keyed resubmission simply runs fresh).
+        Caller holds the scheduler lock; returns ``(records,
+        live job ids)`` — the ids feed the orphan-spool sweep."""
+        recs: List[dict] = []
+        live: List[str] = []
+        for job in self._jobs.values():
+            if (job.state in (FAILED, CANCELLED)
+                    and not job.shutdown_orphan) or \
+                    (job.state == DONE and job.collected):
+                continue
+            live.append(job.id)
+            recs.append({"rec": "submitted", "job": job.id,
+                         "key": job.key, "cost": job.cost,
+                         "unix": round(job.submitted_unix, 3),
+                         "spec": job.spec})
+            for k in range(job.journal_runs):
+                recs.append({"rec": "running", "job": job.id,
+                             "worker": job.worker, "run": k + 1})
+            if job.state == DONE:
+                recs.append({"rec": "done", "job": job.id,
+                             "bytes": job.result_bytes,
+                             "crc32": job.crc32, "spool": job.spool,
+                             "wall_s": round(job.wall_s, 3),
+                             "engine": job.engine})
+        return recs, live
+
+    def _compact(self) -> None:
+        """Rewrite the journal to live jobs only (atomic tmp → fsync →
+        rename) and sweep orphaned spool files — what keeps a
+        long-lived server's serve-dir bounded."""
+        j = self._journal
+        if j is None:
+            return
+        # lock order journal -> state, matching every append site
+        # (appends happen outside the scheduler lock); the round-15
+        # lock-order witness checks this under RACON_TPU_SANITIZE=1.
+        # Snapshot and rewrite happen under ONE journal-lock hold so a
+        # concurrent append cannot slip between them and be dropped.
+        with j.lock:
+            with self._cond:
+                recs, live = self._live_records_locked()
+            # graftlint: disable=blocking-under-lock (snapshot+rewrite must be one atomic hold vs appends)
+            j.rewrite_locked(recs)
+        j.sweep_spool(live)
+
+    def _maybe_compact(self) -> None:
+        j = self._journal
+        if j is not None and \
+                j.appends_since_rewrite >= j.compact_every:
+            self._compact()
+
+    def _recover(self) -> None:
+        """Restart recovery: replay the journal and pick every live job
+        back up — completed jobs serve from the (CRC-verified) spool
+        without re-polishing, queued/running jobs re-enter the queue in
+        submission order walking the crash ladder, and terminal jobs
+        answer status queries.  Runs before any worker starts."""
+        if self._journal is None:
+            return
+        records = self._journal.replay()
+        metrics.inc("serve.journal_replayed", len(records))
+        by_job: Dict[str, List[dict]] = {}
+        order: List[str] = []
+        for rec in records:
+            jid = rec.get("job")
+            if not isinstance(jid, str):
+                continue
+            if jid not in by_job:
+                order.append(jid)
+            by_job.setdefault(jid, []).append(rec)
+        max_id = 0
+        n_live = n_spool = n_requeued = 0
+        for jid in order:
+            recs = by_job[jid]
+            sub = next((r for r in recs
+                        if r.get("rec") == "submitted"), None)
+            if sub is None:
+                continue  # unreadable head: nothing admissible remains
+            if jid.startswith("j") and jid[1:].isdigit():
+                max_id = max(max_id, int(jid[1:]))
+            if any(r.get("rec") == "collected" for r in recs):
+                continue  # fully retired; compaction fodder
+            kinds = {r.get("rec"): r for r in recs}
+            spec, err = protocol.normalize_spec(sub.get("spec") or {})
+            if spec is None:
+                warn(f"journal job {jid} has an unreadable spec "
+                     f"({err}) — dropping it")
+                continue
+            job = Job(jid, spec, int(sub.get("cost", 0)))
+            job.key = sub.get("key") or None
+            job.recovered = True
+            job.submitted_unix = float(sub.get("unix") or
+                                       job.submitted_unix)
+            job.journal_runs = sum(1 for r in recs
+                                   if r.get("rec") == "running")
+            n_live += 1
+            if "cancelled" in kinds:
+                job.state = CANCELLED
+                job.error = "cancelled by client (before the restart)"
+                self._register_recovered(job)
+                continue
+            if "failed" in kinds:
+                job.state = FAILED
+                job.error = str(kinds["failed"].get("error") or
+                                "failed (before the restart)")
+                self._register_recovered(job)
+                continue
+            done_rec = kinds.get("done")
+            if done_rec is not None:
+                blob = self._journal.spool_read(
+                    jid, int(done_rec.get("bytes", -1)),
+                    int(done_rec.get("crc32", 0)))
+                if blob is not None:
+                    # served from the spool: completed-at-crash work is
+                    # NOT re-polished (the soak asserts zero duplicate
+                    # running records for these)
+                    job.state = DONE
+                    job.spool = done_rec.get("spool") or \
+                        self._journal.spool_name(jid)
+                    job.result_bytes = int(done_rec.get("bytes", 0))
+                    job.crc32 = int(done_rec.get("crc32", 0))
+                    job.wall_s = float(done_rec.get("wall_s") or 0.0)
+                    job.engine = done_rec.get("engine")
+                    n_spool += 1
+                    self._register_recovered(job)
+                    continue
+                # truncated/corrupt spool: the result is lost — requeue
+                # the job instead of serving garbage (the round-12
+                # part-verification rule)
+                metrics.inc("serve.spool_corrupt")
+                warn(f"job {jid}: result spool failed verification — "
+                     f"re-queueing instead of serving a corrupt result")
+            # queued or running at crash time: re-admit down the ladder
+            job.crash_count = job.journal_runs
+            for k in range(job.crash_count):
+                job.attempts.append({
+                    "n": k, "engine": "primary", "class": "crash",
+                    "error": "server died while the job was running",
+                    "action": ("fail" if k + 1 >= _MAX_JOB_CRASHES
+                               else "requeue")})
+            if job.crash_count >= _MAX_JOB_CRASHES:
+                job.state = FAILED
+                job.error = (f"the server crashed {job.crash_count} "
+                             f"times while running this job — failing "
+                             f"it down the ladder instead of an "
+                             f"infinite redo loop")
+                self._register_recovered(job)
+                continue
+            with self._cond:
+                self._queue.append(job)
+            n_requeued += 1
+            self._register_recovered(job)
+        with self._cond:
+            self._next_id = max(self._next_id, max_id)
+        metrics.inc("serve.recovered_jobs", n_live)
+        metrics.inc("serve.requeued_jobs", n_requeued)
+        metrics.inc("serve.spool_served", n_spool)
+        if n_live:
+            _eprint(f"recovery: {n_live} journaled job(s) restored "
+                    f"({n_spool} served from the result spool, "
+                    f"{n_requeued} re-queued) from {self.serve_dir}")
+        # clean-startup compaction: the replayed history is rewritten
+        # live-jobs-only, so crash-looped serve dirs stay bounded
+        self._compact()
+
+    def _register_recovered(self, job: Job) -> None:
+        with self._cond:
+            self._jobs[job.id] = job
+            if job.key:
+                self._by_key[job.key] = job.id
+            self._counts["submitted"] += 1
+            if job.state in _TERMINAL:
+                self._counts[job.state] = \
+                    self._counts.get(job.state, 0) + 1
+                self._retired.append(job.id)
+                job.done.set()
+            self._cond.notify_all()
+
+    # --------------------------------------------------- slot supervision
+
+    def healthy_workers(self) -> int:
+        """Advertised capacity: resolved slots minus quarantined ones
+        (admission reads this — a server whose every slot died stops
+        accepting instead of queueing into a black hole)."""
+        with self._slots_lock:
+            slots = self._slots or []
+            return sum(1 for w in slots
+                       if w.ordinal not in self._quarantined)
+
+    def _supervise_loop(self) -> None:
+        """Slot supervision: a worker thread that died outside the
+        per-job ladder (device fault, unhandled exception, injected
+        ``serve.slot`` chaos) is detected here; its job fails down the
+        per-job crash ladder and the slot restarts with fresh engines.
+        Repeated deaths quarantine the slot — capacity shrinks, the
+        server survives."""
+        while not self._stop.wait(_SUPERVISE_POLL_S):
+            with self._slots_lock:
+                slots = list(self._slots or [])
+            for idx, slot in enumerate(slots):
+                t = self._slot_threads.get(slot.ordinal)
+                if t is None or t.is_alive() or self._stop.is_set():
+                    continue
+                if slot.ordinal in self._quarantined:
+                    continue
+                self._handle_slot_death(idx, slot)
+
+    def _handle_slot_death(self, idx: int, slot: _ChipWorker) -> None:
+        deaths = self._slot_deaths.get(slot.ordinal, 0) + 1
+        self._slot_deaths[slot.ordinal] = deaths
+        metrics.inc("slot.deaths")
+        job = slot.current_job
+        failed_job = None
+        with self._cond:
+            if job is not None and job.state == RUNNING:
+                # the dying thread never reached its finally: the
+                # footprint reservation and the job are both orphaned
+                self._running_cost -= job.cost
+                job.crash_count += 1
+                att = {"n": len(job.attempts), "engine": "primary",
+                       "class": "crash",
+                       "error": f"worker slot {slot.worker} died while "
+                                f"running this job"}
+                job.attempts.append(att)
+                if job.crash_count >= _MAX_JOB_CRASHES:
+                    att["action"] = "fail"
+                    job.state = FAILED
+                    job.error = (f"executor died {job.crash_count} "
+                                 f"times on this job — failing it "
+                                 f"down the ladder")
+                    self._counts["failed"] = \
+                        self._counts.get("failed", 0) + 1
+                    self._retired.append(job.id)
+                    failed_job = job
+                else:
+                    att["action"] = "requeue"
+                    job.state = QUEUED
+                    job.worker = None
+                    job.started_at = None
+                    # head of the queue: it was already running
+                    self._queue.insert(0, job)
+                self._cond.notify_all()
+            slot.current_job = None
+        if failed_job is not None:
+            self._journal_terminal(failed_job)
+            failed_job.done.set()
+        if deaths >= _SLOT_QUARANTINE_DEATHS:
+            self._quarantined.add(slot.ordinal)
+            metrics.inc("slot.quarantined")
+            warn(f"worker slot {slot.worker} died {deaths} times — "
+                 f"quarantining it (advertised capacity is now "
+                 f"{self.healthy_workers()} worker(s))")
+            if self.healthy_workers() == 0:
+                warn("every worker slot is quarantined — failing "
+                     "queued jobs and rejecting new submissions")
+                with self._cond:
+                    stranded = list(self._queue)
+                    for queued in stranded:
+                        queued.state = FAILED
+                        queued.error = ("no healthy worker slots left "
+                                        "(all quarantined)")
+                        self._counts["failed"] = \
+                            self._counts.get("failed", 0) + 1
+                        self._retired.append(queued.id)
+                        queued.done.set()
+                    self._queue.clear()
+                    self._cond.notify_all()
+                # journal the failures (outside the lock): the clients
+                # were TOLD failed — a restart must not resurrect and
+                # re-run jobs nobody will ever fetch
+                for queued in stranded:
+                    self._journal_terminal(queued)
+            return
+        fresh = _ChipWorker(self, slot.slot, pinned=slot.device is not None)
+        fresh.worker = slot.worker  # keep the identity stable
+        with self._slots_lock:
+            if self._slots is not None and idx < len(self._slots) \
+                    and self._slots[idx] is slot:
+                self._slots[idx] = fresh
+            # drop the dead thread's registration NOW: until the
+            # replacement registers, an absent mapping reads as
+            # "not started yet" and the supervisor skips it (leaving
+            # it would re-detect the same death next tick)
+            self._slot_threads.pop(slot.ordinal, None)
+        metrics.inc("slot.restarts")
+        _eprint(f"slot {slot.worker} died (death {deaths}/"
+                f"{_SLOT_QUARANTINE_DEATHS}) — restarting it with "
+                f"fresh engines")
+        self._spawn_worker(fresh)
 
     # ----------------------------------------------------------- protocol
 
@@ -600,16 +1080,26 @@ class PolishServer:
         """Handle one request; False ends the connection loop."""
         op = msg.get("op")
         if op == "ping":
+            self._chip_slots()  # resolve before counting capacity
             protocol.send_msg(conn, {
                 "ok": True, "server": self.worker,
                 "uptime_s": round(time.perf_counter() - self._t0, 3),
                 "profile": {"match": self.match,
                             "mismatch": self.mismatch, "gap": self.gap,
                             "banded": self.banded},
-                "workers": len(self._chip_slots())})
+                "workers": self.healthy_workers(),
+                "serve_dir": self.serve_dir,
+                "draining": self._draining})
             return True
         if op == "submit":
-            job, reason = self._admit(msg.get("spec", {}))
+            key = msg.get("key")
+            if key is not None and not isinstance(key, str):
+                protocol.send_msg(conn, {
+                    "ok": False,
+                    "error": "idempotency key must be a string"})
+                return True
+            job, reason, existing = self._admit(msg.get("spec", {}),
+                                                key=key)
             if job is None:
                 with self._lock:
                     self._counts["rejected"] += 1
@@ -618,7 +1108,8 @@ class PolishServer:
                 return True
             protocol.send_msg(conn, {"ok": True, "job": job.id,
                                      "state": job.state,
-                                     "cost_bytes": job.cost})
+                                     "cost_bytes": job.cost,
+                                     "existing": existing})
             return True
         if op in ("status", "result", "cancel"):
             job = self._jobs.get(msg.get("job", ""))
@@ -642,15 +1133,36 @@ class PolishServer:
                 counts = dict(self._counts)
                 depth = len(self._queue)
                 running = self._running_cost
-            protocol.send_msg(conn, {
+            out = {
                 "ok": True, **counts, "queued": depth,
                 "running_cost_bytes": running,
                 "budget_bytes": self.budget_bytes,
-                "peak_rss_bytes": metrics.peak_rss_bytes()})
+                "peak_rss_bytes": metrics.peak_rss_bytes(),
+                "quarantined_slots": len(self._quarantined),
+                "slot_restarts": int(metrics.counter("slot.restarts"))}
+            if self._journal is not None:
+                out["serve_dir"] = self.serve_dir
+                out["recovery"] = metrics.recovery_summary()
+            protocol.send_msg(conn, out)
             return True
         if op == "shutdown":
-            protocol.send_msg(conn, {"ok": True, "state": "stopping"})
-            self.shutdown()
+            mode = msg.get("mode", "now")
+            if mode not in ("now", "drain"):
+                protocol.send_msg(conn, {
+                    "ok": False,
+                    "error": f"unknown shutdown mode {mode!r} "
+                             f"(now | drain)"})
+                return True
+            if mode == "drain":
+                # admission must be stopped BEFORE the reply lands: a
+                # client that sees "draining" and immediately submits
+                # must deterministically be rejected
+                with self._lock:
+                    self._draining = True
+            protocol.send_msg(conn, {
+                "ok": True,
+                "state": "draining" if mode == "drain" else "stopping"})
+            self.shutdown(mode=mode)
             return False
         protocol.send_msg(conn, {"ok": False,
                                  "error": f"unknown op {op!r}"})
@@ -671,6 +1183,14 @@ class PolishServer:
         # client slow to drain its socket must not stall every worker
         # contending for the state lock
         if cancelled:
+            if self._journal is not None:
+                try:
+                    self._journal.append({"rec": "cancelled",
+                                          "job": job.id})
+                except Exception as e:
+                    log_swallowed(
+                        "serve: journal cancel record failed (the job "
+                        "would re-run after a restart)", e)
             protocol.send_msg(conn, {"ok": True, "job": job.id,
                                      "state": job.state})
             return True
@@ -697,6 +1217,31 @@ class PolishServer:
             return True
         with self._lock:
             blob = job.result
+            spool = job.spool if self._journal is not None else None
+            collected = job.collected
+        if blob is None and spool and not collected:
+            # spooled result (--serve-dir): re-read and CRC-verify on
+            # EVERY fetch — a disk that lied about fsync or flipped a
+            # bit must re-queue the job, never stream garbage (the
+            # round-12 part-verification rule)
+            blob = self._journal.spool_read(job.id, job.result_bytes,
+                                            job.crc32)
+            if blob is None:
+                with self._lock:
+                    racing_collected = job.collected
+                if not racing_collected:
+                    self._requeue_corrupt_spool(job)
+                    header.update(
+                        ok=False, state=job.state,
+                        error=f"job {job.id} result spool failed "
+                              f"verification — the job was re-queued; "
+                              f"retry the fetch")
+                    protocol.send_msg(conn, header)
+                    return True
+                # a racing fetcher streamed + unlinked the spool while
+                # we were between the snapshot and the read: the result
+                # was DELIVERED, not lost — answer "collected", never
+                # re-queue already-delivered work
         if blob is None:
             why = ("was already collected (payloads are retained for "
                    "one successful fetch)" if job.collected
@@ -717,9 +1262,50 @@ class PolishServer:
             # waiting must be able to reconnect and fetch (two racing
             # fetchers both succeed; the second drop is a no-op).
             with self._lock:
+                newly = not job.collected
                 job.result = None
                 job.collected = True
+            if newly and self._journal is not None:
+                try:
+                    self._journal.append({"rec": "collected",
+                                          "job": job.id})
+                except Exception as e:
+                    log_swallowed(
+                        "serve: journal collected record failed (the "
+                        "result would be re-servable after a restart "
+                        "— safe)", e)
+                self._journal.spool_unlink(job.id)
+                self._maybe_compact()
         return True
+
+    def _requeue_corrupt_spool(self, job: Job) -> None:
+        """A spooled result that fails verification is LOST work, not
+        servable work: put the job back at the head of the queue (it
+        re-polishes byte-identically) — mirroring the exec runner's
+        corrupt-part re-queue."""
+        with self._cond:
+            if job.state != DONE or job.collected:
+                return  # racing fetcher re-queued it / already served
+            metrics.inc("serve.spool_corrupt")
+            warn(f"job {job.id}: result spool corrupt at fetch time — "
+                 f"re-queueing the job")
+            job.state = QUEUED
+            job.done.clear()
+            job.result = None
+            job.spool = None
+            job.attempts.append({
+                "n": len(job.attempts), "engine": "primary",
+                "class": "spool-corrupt", "action": "requeue",
+                "error": "result spool failed size/CRC verification"})
+            # it is live again: pull it back off the retention horizon,
+            # or 1024 later terminals would evict it mid-queue (and its
+            # re-completion would double-append the horizon entry)
+            try:
+                self._retired.remove(job.id)
+            except ValueError:
+                pass
+            self._queue.insert(0, job)
+            self._cond.notify_all()
 
     # ---------------------------------------------------------- lifecycle
 
@@ -750,18 +1336,31 @@ class PolishServer:
                     + f", {depth} queued, "
                     f"peak_rss={metrics.peak_rss_bytes() >> 20}MB")
 
+    def _spawn_worker(self, w: _ChipWorker) -> None:
+        t = threading.Thread(target=self._worker_loop, args=(w,),
+                             name=f"racon-serve-{w.worker}",
+                             daemon=True)
+        t.start()
+        # registered under the slots lock (startup and the supervisor
+        # both spawn), and only AFTER start() — a registered-but-not-
+        # started thread reads as dead and would trip the supervisor
+        with self._slots_lock:
+            self._threads.append(t)
+            self._slot_threads[w.ordinal] = t
+
     def start_workers(self) -> None:
-        """Spawn the pool's worker threads (idempotent; split out so
-        tests can exercise the queue deterministically before any
-        worker drains it)."""
+        """Spawn the pool's worker threads plus their supervisor
+        (idempotent; split out so tests can exercise the queue
+        deterministically before any worker drains it)."""
         if self._threads:
             return
         for w in self._chip_slots():
-            t = threading.Thread(target=self._worker_loop, args=(w,),
-                                 name=f"racon-serve-{w.worker}",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._spawn_worker(w)
+        # graftlint: disable=lock-discipline (start_workers runs once, guarded by the _threads check, on the single startup path)
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop,
+            name="racon-serve-supervisor", daemon=True)
+        self._supervisor.start()
 
     def _bind(self) -> socket.socket:
         path = self.socket_path
@@ -807,9 +1406,26 @@ class PolishServer:
         # in tests) — its attribute writes below never race themselves
         # graftlint: disable=lock-discipline (serve_forever runs on exactly one thread per server instance)
         self._listener = self._bind()
+        # restart recovery BEFORE any worker can drain the queue: the
+        # journal's live jobs re-enter in submission order
+        self._recover()
         self._warm_pool()
         if self.autostart:
             self.start_workers()
+        # graceful drain on SIGTERM (the preemption signal): stop
+        # admission, finish in-flight jobs, flush the journal, exit.
+        # Only the process main thread may install handlers (in-process
+        # test servers run serve_forever on a spawned thread).
+        if threading.current_thread() is threading.main_thread():
+            try:
+                signal_mod.signal(
+                    signal_mod.SIGTERM,
+                    lambda *_: threading.Thread(
+                        target=self.shutdown, kwargs={"mode": "drain"},
+                        name="racon-serve-drain", daemon=True).start())
+            except (ValueError, OSError) as e:
+                log_swallowed("serve: SIGTERM drain handler "
+                              "unavailable", e)
         interval = flags.get_float("RACON_TPU_HEARTBEAT_S")
         if interval > 0:
             t = threading.Thread(target=self._heartbeat_loop,
@@ -835,23 +1451,75 @@ class PolishServer:
                                       if c.is_alive()]
         finally:
             self.shutdown()
-            for t in self._threads:
+            for t in list(self._threads):
                 t.join()
+            if self._supervisor is not None:
+                self._supervisor.join()
+            self._finish_journal()
         _eprint(f"stopped ({self._counts['done']} done, "
                 f"{self._counts['failed']} failed, "
                 f"{self._counts['rejected']} rejected)")
         return 0
 
-    def shutdown(self) -> None:
-        """Stop accepting, let running jobs finish, fail what is still
-        queued (idempotent)."""
+    def _finish_journal(self) -> None:
+        """Final flush: one last live-jobs-only compaction (every
+        worker has exited, so the snapshot is the run's terminal truth)
+        and a clean close — the 'flushes the journal, then exits' leg
+        of the drain contract."""
+        if self._journal is None:
+            return
+        try:
+            self._compact()
+        except Exception as e:
+            log_swallowed("serve: final journal compaction failed "
+                          "(the un-compacted journal replays fine)", e)
+        self._journal.close()
+
+    def shutdown(self, mode: str = "now") -> None:
+        """Stop the server (idempotent).  ``mode="now"``: stop
+        admission and scheduling immediately — running jobs finish,
+        queued jobs are answered FAILED in RAM but deliberately NOT
+        journaled as failed, so a ``--serve-dir`` server recovers and
+        runs them after restart.  ``mode="drain"``: stop admission,
+        wait (bounded by ``RACON_TPU_SERVE_DRAIN_S``) for the queue
+        AND the in-flight jobs to finish, then stop."""
+        if mode == "drain" and not self._stop.is_set():
+            with self._cond:
+                self._draining = True
+            _eprint("drain: admission stopped — finishing queued "
+                    "and in-flight jobs")
+            bound = flags.get_float("RACON_TPU_SERVE_DRAIN_S")
+            deadline = (time.monotonic() + bound) if bound > 0 \
+                else None
+            drained = True
+            with self._cond:
+                while self._queue or any(
+                        j.state == RUNNING
+                        for j in self._jobs.values()):
+                    if self._stop.is_set():
+                        drained = False
+                        break
+                    if deadline is not None and \
+                            time.monotonic() > deadline:
+                        warn(f"drain: still busy after {bound:.0f}s "
+                             f"(RACON_TPU_SERVE_DRAIN_S) — stopping "
+                             f"anyway")
+                        drained = False
+                        break
+                    self._cond.wait(0.2)
+            if drained:
+                _eprint("drain: all jobs finished")
         if self._stop.is_set():
             return
         self._stop.set()
         with self._cond:
             for job in self._queue:
                 job.state = FAILED
-                job.error = "server shutdown before the job ran"
+                job.shutdown_orphan = self._journal is not None
+                job.error = ("server shutdown before the job ran"
+                             + (" — it is journaled and will recover "
+                                "on restart from the same --serve-dir"
+                                if self._journal is not None else ""))
                 job.done.set()
             self._queue.clear()
             self._cond.notify_all()

@@ -1,0 +1,625 @@
+"""Crash-safe resident serving (round 16): the durable job journal,
+restart recovery, slot supervision, the drain protocol and the
+retrying client.
+
+The crash contract under test: the death of ANY participant — server
+process (SIGKILL mid-batch), chip-worker slot (thread death), or
+client connection — loses no work and duplicates none.  The headline
+is the kill-server chaos soak: K jobs submitted to a 2-slot server,
+the server SIGKILLed mid-batch by ``RACON_TPU_FAULTS=server.kill``, a
+restart from the same ``--serve-dir`` — and every job's result is
+byte-identical to its one-shot CLI run, jobs completed at crash time
+are NOT re-polished (the journal shows zero duplicate ``running``
+records for them), and the schema-v5 report's ``recovery`` counts
+match.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from racon_tpu import faults
+from racon_tpu.obs import metrics
+from racon_tpu.obs.report import validate_report
+from racon_tpu.serve.client import ServiceClient
+from racon_tpu.serve.journal import JobJournal
+from racon_tpu.serve.service import PolishServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -------------------------------------------------------------- workloads
+
+def _assembly(td, sizes, seed=31, prefix="a"):
+    """Synthetic per-contig assembly triple (the test_serve generator,
+    re-homed so the recovery tests stand alone)."""
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    comp = bytes.maketrans(b"ACGT", b"TGCA")
+
+    def mutate(seq, rate):
+        out = seq.copy()
+        flips = rng.random(len(out)) < rate
+        out[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        return out
+
+    truths = [bases[rng.integers(0, 4, n)] for n in sizes]
+    layout = os.path.join(td, f"{prefix}_layout.fasta")
+    with open(layout, "wb") as f:
+        for ti, t in enumerate(truths):
+            f.write(b">ctg%d\n" % ti + mutate(t, 0.06).tobytes() + b"\n")
+    reads = os.path.join(td, f"{prefix}_reads.fastq")
+    paf = os.path.join(td, f"{prefix}_ovl.paf")
+    with open(reads, "wb") as rf, open(paf, "wb") as pf:
+        ri = 0
+        for ti, truth in enumerate(truths):
+            contig = len(truth)
+            for start in range(0, max(1, contig - 600), 150):
+                end = min(start + 900, contig)
+                read = mutate(truth[start:end], 0.08)
+                name = b"%s_read%d" % (prefix.encode(), ri)
+                strand = b"-" if ri % 3 == 0 else b"+"
+                rb = (read.tobytes().translate(comp)[::-1]
+                      if strand == b"-" else read.tobytes())
+                rf.write(b"@" + name + b"\n" + rb + b"\n+\n"
+                         + b"9" * len(read) + b"\n")
+                pf.write(b"\t".join([
+                    name, b"%d" % len(read), b"0", b"%d" % len(read),
+                    strand, b"ctg%d" % ti, b"%d" % contig,
+                    b"%d" % start, b"%d" % end, b"%d" % (len(read) // 2),
+                    b"%d" % len(read), b"255"]) + b"\n")
+                ri += 1
+    return reads, paf, layout
+
+
+def _spec(reads, paf, layout, **opts):
+    spec = {"sequences": reads, "overlaps": paf,
+            "target_sequences": layout, "window_length": 150,
+            "threads": 2}
+    spec.update(opts)
+    return spec
+
+
+def _oneshot_cli(reads, paf, layout, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "racon_tpu", "-w", "150", "-t", "2",
+         *extra, reads, paf, layout],
+        capture_output=True, timeout=600, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    return proc.stdout
+
+
+@pytest.fixture()
+def short_tmp():
+    """AF_UNIX socket paths are length-bounded (~107 bytes); sockets
+    live in a short /tmp dir."""
+    with tempfile.TemporaryDirectory(dir="/tmp", prefix="rrec") as td:
+        yield td
+
+
+class _Server:
+    """In-process server harness (the test_serve one, plus serve_dir)."""
+
+    def __init__(self, td, **kw):
+        self.server = PolishServer(os.path.join(td, "racon.sock"), **kw)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.server.started.wait(60), "server did not start"
+        return self.server
+
+    def __exit__(self, exc_type, exc, tb):
+        self.server.shutdown()
+        self.thread.join(timeout=30)
+        return False
+
+
+def _journal_records(serve_dir):
+    path = os.path.join(serve_dir, "journal.jsonl")
+    out = []
+    with open(path, "rb") as f:
+        for line in f.read().splitlines():
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def _running_counts(records):
+    counts = {}
+    for r in records:
+        if r.get("rec") == "running":
+            counts[r["job"]] = counts.get(r["job"], 0) + 1
+    return counts
+
+
+# ------------------------------------------------------- kill-server soak
+
+def test_chaos_kill_restart_soak(short_tmp):
+    """THE crash contract: SIGKILL the server mid-batch (injected
+    ``server.kill`` on the 3rd job start), restart it on the same
+    --serve-dir, and assert byte-identity for every job, zero
+    re-polishing of jobs already journaled done, idempotency-key
+    dedupe across the restart, and the v5 report's recovery counts."""
+    n_jobs = 4
+    triples = [_assembly(short_tmp, [1500 + 150 * i], seed=11 + i,
+                         prefix=f"k{i}") for i in range(n_jobs)]
+    want = [_oneshot_cli(*t) for t in triples]
+    sock = os.path.join(short_tmp, "racon.sock")
+    serve_dir = os.path.join(short_tmp, "serve_dir")
+    log_a = open(os.path.join(short_tmp, "server_a.log"), "wb")
+    base_cmd = [sys.executable, "-m", "racon_tpu", "--serve", sock,
+                "--serve-dir", serve_dir, "-w", "150", "-t", "2",
+                "--workers", "2"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_SERVE_WARM_SHAPES="",
+               RACON_TPU_FAULTS="server.kill:kill@3")
+    server_a = subprocess.Popen(base_cmd, cwd=REPO_ROOT, env=env,
+                                stderr=log_a)
+    job_ids = []
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            assert time.monotonic() < deadline, "server A did not start"
+            assert server_a.poll() is None, "server A died at startup"
+            time.sleep(0.1)
+        for i, t in enumerate(triples):
+            with ServiceClient(sock, timeout_s=60) as c:
+                resp = c.submit(_spec(*t), key=f"soak{i}")
+                assert resp["ok"], resp
+                job_ids.append(resp["job"])
+        # the injected fault SIGKILLs the server on the 3rd job start:
+        # by then >=1 job is done (2 slots drain jobs 1 and 2 first)
+        server_a.wait(timeout=600)
+        assert server_a.returncode == -9, \
+            f"server A exited {server_a.returncode}, wanted SIGKILL"
+    finally:
+        if server_a.poll() is None:
+            server_a.kill()
+            server_a.wait()
+        log_a.close()
+    # pre-restart journal truth: which jobs completed before the kill
+    pre = _journal_records(serve_dir)
+    done_jobs = {r["job"] for r in pre if r.get("rec") == "done"}
+    running_pre = _running_counts(pre)
+    assert len(done_jobs) >= 1, "kill landed before any job finished"
+    assert done_jobs < set(job_ids), "kill landed after every job"
+    for j in done_jobs:
+        assert running_pre[j] == 1
+
+    # SIGKILL left the socket file behind; drop it so the wait below
+    # detects the RESTARTED server's bind, not the stale path
+    try:
+        os.unlink(sock)
+    except FileNotFoundError:
+        pass
+    env_b = dict(os.environ, JAX_PLATFORMS="cpu",
+                 RACON_TPU_SERVE_WARM_SHAPES="")
+    env_b.pop("RACON_TPU_FAULTS", None)
+    log_b = open(os.path.join(short_tmp, "server_b.log"), "wb")
+    server_b = subprocess.Popen(base_cmd, cwd=REPO_ROOT, env=env_b,
+                                stderr=log_b)
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            assert time.monotonic() < deadline, "server B did not start"
+            assert server_b.poll() is None, "server B died at startup"
+            time.sleep(0.1)
+        # startup compaction preserved the live history: still exactly
+        # ONE running record per completed-at-crash job (zero
+        # duplicate polishing — they serve from the spool)
+        post = _running_counts(_journal_records(serve_dir))
+        for j in done_jobs:
+            assert post.get(j, 0) == 1, \
+                f"job {j} was re-polished after recovery: {post}"
+        # a resubmission under an already-journaled key returns the
+        # EXISTING job, not a duplicate
+        with ServiceClient(sock, timeout_s=60) as c:
+            dup = c.submit(_spec(*triples[0]), key="soak0")
+            assert dup["ok"] and dup["existing"]
+            assert dup["job"] == job_ids[0]
+        # every job's result — recovered-from-spool or re-run — is
+        # byte-identical to its one-shot CLI run
+        report = None
+        for i, jid in enumerate(job_ids):
+            with ServiceClient(sock, timeout_s=900) as c:
+                header, payload = c.result(jid, timeout_s=850)
+                assert header["ok"], (jid, header)
+                assert payload == want[i], \
+                    f"job {jid} diverged from its one-shot run"
+                if header.get("report"):
+                    report = header["report"]
+        # recovered done jobs keep no per-crash report; a re-run job
+        # carries a fresh v5 report whose recovery section holds the
+        # server's restart truth
+        assert report is not None
+        assert validate_report(report) == [], validate_report(report)
+        rec = report["recovery"]
+        assert rec["recovered_jobs"] == n_jobs
+        assert rec["served_from_spool"] == len(done_jobs)
+        assert rec["requeued_jobs"] == n_jobs - len(done_jobs)
+        assert rec["journal_replayed"] > 0
+        assert rec["journal_compactions"] >= 1
+        with ServiceClient(sock, timeout_s=60) as c:
+            c.shutdown()
+        server_b.wait(timeout=120)
+    finally:
+        if server_b.poll() is None:
+            server_b.kill()
+            server_b.wait()
+        log_b.close()
+
+
+# --------------------------------------------- in-process restart recovery
+
+def test_restart_serves_done_from_spool(short_tmp, monkeypatch):
+    """A job completed (and never fetched) before a stop is served
+    from the CRC-verified spool by the restarted server — no
+    re-polish (journal_runs stays 1) — and its bytes match the
+    one-shot run."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    reads, paf, layout = _assembly(short_tmp, [2000], seed=7)
+    want = _oneshot_cli(reads, paf, layout)
+    serve_dir = os.path.join(short_tmp, "sd")
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            jid = c.submit(_spec(reads, paf, layout), key="spool1")["job"]
+            st = c.status(jid)
+            deadline = time.monotonic() + 300
+            while st["state"] not in ("done", "failed"):
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+                st = c.status(jid)
+            assert st["state"] == "done"
+        # job done, result spooled, NOT fetched
+        assert server._jobs[jid].result is None  # RAM holds no payload
+        assert os.path.exists(os.path.join(serve_dir, "spool",
+                                           f"result_{jid}.fasta"))
+    base_spool = metrics.counter("serve.spool_served")
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            header, payload = c.result(jid, timeout_s=60)
+            assert header["ok"], header
+            assert payload == want
+            # the recovered job was never re-run
+            assert server._jobs[jid].journal_runs == 1
+            # ...and the key still dedupes to it
+            dup = c.submit(_spec(reads, paf, layout), key="spool1")
+            assert dup["ok"] and dup["existing"] and dup["job"] == jid
+    assert metrics.counter("serve.spool_served") == base_spool + 1
+
+
+def test_restart_requeues_queued_jobs(short_tmp, monkeypatch):
+    """Jobs still queued at shutdown survive: the journal re-admits
+    them on restart (in submission order) and they complete
+    byte-identically under their ORIGINAL ids."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    reads, paf, layout = _assembly(short_tmp, [1800], seed=19)
+    want = _oneshot_cli(reads, paf, layout)
+    serve_dir = os.path.join(short_tmp, "sd")
+    with _Server(short_tmp, autostart=False,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            j1 = c.submit(_spec(reads, paf, layout))["job"]
+            j2 = c.submit(_spec(reads, paf, layout))["job"]
+    # hard stop answered the waiting clients FAILED but deliberately
+    # did not journal the failures — the disk still says "submitted"
+    base_requeued = metrics.counter("serve.requeued_jobs")
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            for jid in (j1, j2):
+                header, payload = c.result(jid, timeout_s=300)
+                assert header["ok"], (jid, header)
+                assert payload == want
+    assert metrics.counter("serve.requeued_jobs") == base_requeued + 2
+
+
+def test_corrupt_spool_requeues_job(short_tmp, monkeypatch):
+    """A truncated/corrupt spool file fails CRC verification at
+    recovery time and the job re-polishes instead of serving garbage
+    (the round-12 part-verification rule, re-homed)."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    reads, paf, layout = _assembly(short_tmp, [1900], seed=23)
+    want = _oneshot_cli(reads, paf, layout)
+    serve_dir = os.path.join(short_tmp, "sd")
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            jid = c.submit(_spec(reads, paf, layout))["job"]
+            st = c.status(jid)
+            deadline = time.monotonic() + 300
+            while st["state"] not in ("done", "failed"):
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+                st = c.status(jid)
+            assert st["state"] == "done"
+    spool = os.path.join(serve_dir, "spool", f"result_{jid}.fasta")
+    with open(spool, "r+b") as f:  # flip a byte: CRC must catch it
+        f.seek(3)
+        b = f.read(1)
+        f.seek(3)
+        f.write(bytes([b[0] ^ 0xFF]))
+    base_corrupt = metrics.counter("serve.spool_corrupt")
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            header, payload = c.result(jid, timeout_s=300)
+            assert header["ok"], header
+            assert payload == want  # re-polished, not served corrupt
+            assert server._jobs[jid].journal_runs >= 2  # it re-ran
+    assert metrics.counter("serve.spool_corrupt") == base_corrupt + 1
+
+
+def test_tmp_litter_swept_on_startup(short_tmp):
+    serve_dir = os.path.join(short_tmp, "sd")
+    spool = os.path.join(serve_dir, "spool")
+    os.makedirs(spool)
+    litter = [os.path.join(serve_dir, "journal.jsonl.tmp"),
+              os.path.join(spool, "result_j1.fasta.tmp")]
+    for p in litter:
+        with open(p, "wb") as f:
+            f.write(b"torn")
+    JobJournal(serve_dir)
+    for p in litter:
+        assert not os.path.exists(p), p
+
+
+# ----------------------------------------------------- journal compaction
+
+def test_journal_compaction_bounds_size(short_tmp, monkeypatch):
+    """A long-lived server's serve-dir stays bounded: with a tiny
+    compaction threshold, N fetched-and-retired jobs leave a journal
+    whose size is bounded by the LIVE set (empty here), not the
+    history, and their spool files are swept."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    monkeypatch.setattr(JobJournal, "compact_every", 4)
+    reads, paf, layout = _assembly(short_tmp, [1600], seed=29)
+    serve_dir = os.path.join(short_tmp, "sd")
+    base_compactions = metrics.counter("serve.journal_compactions")
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path, timeout_s=600) as c:
+            for k in range(5):
+                jid = c.submit(_spec(reads, paf, layout))["job"]
+                header, payload = c.result(jid, timeout_s=300)
+                assert header["ok"] and payload
+                # the `collected` journal append happens on the
+                # connection thread after sendall: wait for it so the
+                # final compaction sees every job fully retired
+                deadline = time.monotonic() + 30
+                while not server._jobs[jid].collected:
+                    assert time.monotonic() < deadline
+                    time.sleep(0.02)
+    assert metrics.counter("serve.journal_compactions") \
+        > base_compactions
+    # every job was collected -> the final compaction leaves NO
+    # records and NO spool files: the size bound the satellite asks for
+    records = _journal_records(serve_dir)
+    assert records == [], records
+    assert os.path.getsize(
+        os.path.join(serve_dir, "journal.jsonl")) == 0
+    assert os.listdir(os.path.join(serve_dir, "spool")) == []
+
+
+def test_append_retry_rolls_back_partial_write(short_tmp, monkeypatch):
+    """A transient append failure that landed PARTIAL bytes must roll
+    the file back before retrying — otherwise the retry welds a torn
+    prefix onto the record and replay halts there for every later
+    job."""
+    import errno
+
+    j = JobJournal(os.path.join(short_tmp, "sd"))
+    j.append({"rec": "submitted", "job": "j1", "cost": 1,
+              "key": None, "unix": 0.0, "spec": {}})
+    import racon_tpu.exec.manifest as mf_mod
+    real = mf_mod.append_durable
+    state = {"fired": False}
+
+    def flaky(f, blob):
+        if not state["fired"]:
+            state["fired"] = True
+            f.write(blob[: len(blob) // 2])
+            f.flush()
+            raise faults.TransientIOError(errno.EIO, "partial append")
+        real(f, blob)
+
+    monkeypatch.setattr("racon_tpu.serve.journal.mf.append_durable",
+                        flaky)
+    j.append({"rec": "running", "job": "j1", "worker": "w", "run": 1})
+    monkeypatch.setattr("racon_tpu.serve.journal.mf.append_durable",
+                        real)
+    recs = j.replay()
+    assert [r["rec"] for r in recs] == ["submitted", "running"], recs
+    j.close()
+
+
+def test_journal_replay_tolerates_torn_tail(short_tmp):
+    j = JobJournal(os.path.join(short_tmp, "sd"))
+    j.append({"rec": "submitted", "job": "j1", "cost": 1,
+              "key": None, "unix": 0.0, "spec": {}})
+    j.append({"rec": "running", "job": "j1", "worker": "w", "run": 1})
+    j.close()
+    with open(j.path, "ab") as f:  # a crash mid-append tears the tail
+        f.write(b'{"rec": "done", "job": "j1", "by')
+    j2 = JobJournal(os.path.join(short_tmp, "sd"))
+    recs = j2.replay()
+    assert [r["rec"] for r in recs] == ["submitted", "running"]
+
+
+# -------------------------------------------------------- idempotent keys
+
+def test_idempotent_double_submit(short_tmp, monkeypatch):
+    """Two submissions under one key admit ONE job; a different key
+    admits another."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    reads, paf, layout = _assembly(short_tmp, [1700], seed=37)
+    serve_dir = os.path.join(short_tmp, "sd")
+    with _Server(short_tmp, autostart=False,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            r1 = c.submit(_spec(reads, paf, layout), key="K")
+            assert r1["ok"] and not r1["existing"]
+            r2 = c.submit(_spec(reads, paf, layout), key="K")
+            assert r2["ok"] and r2["existing"]
+            assert r2["job"] == r1["job"]
+            r3 = c.submit(_spec(reads, paf, layout), key="K2")
+            assert r3["ok"] and not r3["existing"]
+            assert r3["job"] != r1["job"]
+            with server._lock:
+                assert len(server._queue) == 2
+            assert server._counts["submitted"] == 2
+
+
+# --------------------------------------------------------- slot supervision
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_slot_death_restarts_and_job_completes(short_tmp, monkeypatch):
+    """A worker-slot thread that dies outside the per-job ladder is
+    detected by the supervisor: the orphaned job re-queues with a
+    crash-ladder record, the slot restarts with fresh engines, and the
+    job completes on the restarted slot."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    monkeypatch.setenv("RACON_TPU_FAULTS", "serve.slot:err@1")
+    faults.reset()
+    reads, paf, layout = _assembly(short_tmp, [1900], seed=41)
+    want = _oneshot_cli(reads, paf, layout)
+    base_restarts = metrics.counter("slot.restarts")
+    with _Server(short_tmp, num_threads=2) as server:
+        with ServiceClient(server.socket_path) as c:
+            jid = c.submit(_spec(reads, paf, layout))["job"]
+            header, payload = c.result(jid, timeout_s=300)
+            assert header["ok"], header
+            assert payload == want
+            classes = [a["class"] for a in header.get("attempts", [])]
+            assert "crash" in classes
+    assert metrics.counter("slot.restarts") == base_restarts + 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_repeated_slot_deaths_quarantine(short_tmp, monkeypatch):
+    """Repeated slot deaths walk the job off the crash ladder (fail
+    after 3) and quarantine the slot — advertised capacity shrinks and
+    admission rejects instead of queueing into a dead pool."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    monkeypatch.setenv("RACON_TPU_FAULTS", "serve.slot:err*")
+    faults.reset()
+    reads, paf, layout = _assembly(short_tmp, [1700], seed=43)
+    base_quarantined = metrics.counter("slot.quarantined")
+    with _Server(short_tmp, num_threads=2) as server:
+        with ServiceClient(server.socket_path) as c:
+            jid = c.submit(_spec(reads, paf, layout))["job"]
+            header, payload = c.result(jid, timeout_s=120)
+            assert not header["ok"] and header["state"] == "failed"
+            assert payload is None
+            acts = [a["action"] for a in header["attempts"]]
+            assert acts.count("requeue") == 2 and acts[-1] == "fail"
+            # the slot died 3 times -> quarantined, capacity 0
+            deadline = time.monotonic() + 30
+            while server.healthy_workers() > 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+            r = c.submit(_spec(reads, paf, layout))
+            assert not r["ok"] and "quarantined" in r["error"]
+    assert metrics.counter("slot.quarantined") == base_quarantined + 1
+
+
+# ------------------------------------------------------------------ drain
+
+def test_drain_protocol(short_tmp, monkeypatch):
+    """shutdown {"mode": "drain"} stops admission immediately, lets the
+    queue finish, flushes the journal, and exits."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    reads, paf, layout = _assembly(short_tmp, [1700], seed=47)
+    serve_dir = os.path.join(short_tmp, "sd")
+    harness = _Server(short_tmp, autostart=False, num_threads=2,
+                      serve_dir=serve_dir)
+    with harness as server:
+        with ServiceClient(server.socket_path) as c:
+            jid = c.submit(_spec(reads, paf, layout))["job"]
+        drainer = ServiceClient(server.socket_path)
+        resp = drainer.shutdown(mode="drain")
+        assert resp["ok"] and resp["state"] == "draining"
+        drainer.close()
+        # admission is stopped the moment the drain begins
+        with ServiceClient(server.socket_path) as c:
+            r = c.submit(_spec(reads, paf, layout))
+            assert not r["ok"] and "drain" in r["error"]
+            # the queued job still runs to completion
+            server.start_workers()
+            header, payload = c.result(jid, timeout_s=300, keep=True)
+            assert header["ok"] and payload
+        deadline = time.monotonic() + 60
+        while not server._stop.is_set():
+            assert time.monotonic() < deadline, "drain never completed"
+            time.sleep(0.2)
+    # the drained server flushed/compacted: the job (uncollected,
+    # keep=True) survives as the journal's one live record set
+    recs = _journal_records(serve_dir)
+    assert {r["rec"] for r in recs} == {"submitted", "running", "done"}
+    assert all(r["job"] == jid for r in recs)
+
+
+# --------------------------------------------------------- retrying client
+
+def test_client_connect_retries_until_server_up(short_tmp, monkeypatch):
+    """ServiceClient's bounded connect retry rides the shared backoff:
+    a server that binds 1s late is reached; a zero-retry client fails
+    fast."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    sock = os.path.join(short_tmp, "racon.sock")
+    with pytest.raises(ConnectionError):
+        ServiceClient(sock, retries=0)
+    harness = _Server(short_tmp, autostart=False)
+
+    def late_start():
+        time.sleep(1.0)
+        harness.thread.start()
+
+    threading.Thread(target=late_start, daemon=True).start()
+    try:
+        c = ServiceClient(sock, timeout_s=60, retries=20,
+                          backoff_s=0.2)
+        assert c.ping()["ok"]
+        c.close()
+    finally:
+        assert harness.server.started.wait(60)
+        harness.server.shutdown()
+        harness.thread.join(timeout=30)
+
+
+def test_client_socket_fault_injection_retries(short_tmp, monkeypatch):
+    """The serve.socket fault site exercises the retry loop
+    deterministically: two injected connect faults, third attempt
+    lands."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    with _Server(short_tmp, autostart=False) as server:
+        monkeypatch.setenv("RACON_TPU_FAULTS", "serve.socket:io@1")
+        faults.reset()
+        c = ServiceClient(server.socket_path, retries=3, backoff_s=0.0)
+        assert c.ping()["ok"]
+        c.close()
+        monkeypatch.delenv("RACON_TPU_FAULTS")
+        faults.reset()
+
+
+def test_backoff_is_deterministic_and_exponential():
+    a = faults.backoff_s(0.5, 0, "tok")
+    b = faults.backoff_s(0.5, 0, "tok")
+    assert a == b  # replayable
+    assert 0.375 <= a <= 0.625  # ±25% jitter around base
+    assert faults.backoff_s(0.5, 3, "tok") == a * 8
+    assert faults.backoff_s(0.0, 5, "x") == 0.0

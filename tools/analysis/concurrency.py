@@ -169,17 +169,25 @@ class BlockingUnderLockRule(Rule):
 
 class AtomicWriteDisciplineRule(Rule):
     """In the durability-critical packages (``exec``, ``serve``,
-    ``obs``), every write-mode ``open()`` must be the tmp -> fsync ->
-    atomic-rename protocol (``manifest.atomic_write`` /
-    ``durable_write`` / ``report.atomic_write_bytes``) or route through
-    it: a raw ``open(path, "wb")`` can leave a torn artifact that a
-    resume or a concurrent worker then trusts.  Allowlisted: functions
-    that open a ``*.tmp*`` name and ``os.replace``/``os.rename`` it
-    into place (the protocol's own writers).  A deliberately raw write
-    (a re-derivable scratch file) takes a reasoned pragma."""
+    ``obs``), every write-mode ``open()`` must be one of the durable
+    protocols or route through them: the tmp -> fsync -> atomic-rename
+    protocol (``manifest.atomic_write`` / ``durable_write`` /
+    ``report.atomic_write_bytes``) for whole artifacts, or — round 16,
+    the job journal's pattern — the **fsync'd-append** protocol: an
+    append-mode open whose records go through ``os.fsync`` /
+    ``manifest.append_durable`` (in the opening function or a sibling
+    method of the same class, the handle-caching journal shape).  A
+    raw ``open(path, "wb")`` can leave a torn artifact that a resume
+    or a concurrent worker then trusts; a raw un-fsync'd append can
+    silently lose acknowledged records.  Allowlisted: functions that
+    open a ``*.tmp*`` name and ``os.replace``/``os.rename`` it into
+    place (the protocol's own writers), and fsync'd appenders.  A
+    deliberately raw write (a re-derivable scratch file) takes a
+    reasoned pragma."""
 
     name = "atomic-write-discipline"
     WRITE_MODES = ("w", "a", "x")
+    APPEND_SYNCERS = ("os.fsync", "append_durable")
 
     def applies(self, rel: str) -> bool:
         return rel.endswith(".py") and rel.startswith(
@@ -203,20 +211,46 @@ class AtomicWriteDisciplineRule(Rule):
                     continue
                 if allowlisted and self._is_tmp_name(fi, call.args[0]):
                     continue
+                if mode.value.startswith("a") and \
+                        self._append_synced(project, fi):
+                    continue
                 out.append(self.finding(
                     module, call,
                     f"raw `open(..., {mode.value!r})` in "
                     f"`{fi.qualname}` bypasses the durable-write "
                     f"protocol — route through "
                     f"manifest.atomic_write/durable_write or "
-                    f"report.atomic_write_bytes (or pragma a "
-                    f"re-derivable scratch file with the reason)"))
+                    f"report.atomic_write_bytes (append-mode: fsync "
+                    f"every record via manifest.append_durable), or "
+                    f"pragma a re-derivable scratch file with the "
+                    f"reason"))
         return out
 
     @staticmethod
     def _renames_tmp(fi: FuncInfo) -> bool:
         return any(dotted(c.func) in ("os.replace", "os.rename")
                    for c in iter_own_calls(fi.node))
+
+    @classmethod
+    def _append_synced(cls, project: Project, fi: FuncInfo) -> bool:
+        """The fsync'd-append allowlist: the opening function — or,
+        for the journal's cached-handle shape, a sibling method of the
+        same class — pushes records through ``os.fsync`` /
+        ``append_durable``, so every acknowledged append is on disk."""
+        if fi.class_name is None:
+            scope = [fi]
+        else:
+            scope = [f for f in project.functions
+                     if f.module is fi.module
+                     and f.class_name == fi.class_name]
+        for f in scope:
+            for call in iter_own_calls(f.node):
+                name = dotted(call.func) or ""
+                if name in cls.APPEND_SYNCERS or \
+                        last_segment(name) == "append_durable" or \
+                        name.endswith(".fsync"):
+                    return True
+        return False
 
     @staticmethod
     def _is_tmp_name(fi: FuncInfo, expr: ast.AST) -> bool:
@@ -246,12 +280,14 @@ class AtomicWriteDisciplineRule(Rule):
 class ThreadLifecycleRule(Rule):
     """Every started thread needs an owner: either its entry point
     loops on a stop/abort event (``self._stop.wait(...)`` /
-    ``.is_set()`` — the daemon-with-shutdown pattern), or something in
-    the spawning class/module ``join()``s it.  A fire-and-forget
-    non-daemon thread hangs interpreter exit; a fire-and-forget daemon
-    thread is killed mid-write at exit with no flush.  A deliberately
-    abandoned thread (a droppable best-effort warm-up) takes a
-    reasoned pragma."""
+    ``.is_set()`` — the daemon-with-shutdown pattern, checked one call
+    level deep since round 16: a supervisor-restartable worker loop
+    whose scheduling helper polls the stop event counts as wired), or
+    something in the spawning class/module ``join()``s it.  A
+    fire-and-forget non-daemon thread hangs interpreter exit; a
+    fire-and-forget daemon thread is killed mid-write at exit with no
+    flush.  A deliberately abandoned thread (a droppable best-effort
+    warm-up) takes a reasoned pragma."""
 
     name = "thread-lifecycle"
 
@@ -263,7 +299,8 @@ class ThreadLifecycleRule(Rule):
         for spawn in project.thread_spawns():
             if spawn.module is not module:
                 continue
-            if any(self._stop_wired(t) for t in spawn.targets):
+            if any(self._stop_wired(project, t)
+                   for t in spawn.targets):
                 continue
             if self._scope_joins(project, spawn):
                 continue
@@ -278,7 +315,7 @@ class ThreadLifecycleRule(Rule):
         return out
 
     @staticmethod
-    def _stop_wired(target: FuncInfo) -> bool:
+    def _polls_stop(target: FuncInfo) -> bool:
         """Does the entry point's own body poll a stop/abort signal?"""
         for call in iter_own_calls(target.node):
             if not isinstance(call.func, ast.Attribute):
@@ -287,6 +324,29 @@ class ThreadLifecycleRule(Rule):
             if call.func.attr in ("wait", "is_set") \
                     and ("stop" in recv or "abort" in recv):
                 return True
+        return False
+
+    @classmethod
+    def _stop_wired(cls, project: Project, target: FuncInfo,
+                    depth: int = 1) -> bool:
+        """Stop-event wiring, directly or one ``self.m()`` call deep —
+        the supervisor-restartable worker-loop shape (round 16): the
+        entry loops forever but its blocking scheduler helper
+        (``self._next_job``) is what polls the stop event."""
+        if cls._polls_stop(target):
+            return True
+        if depth <= 0 or target.class_name is None:
+            return False
+        for call in iter_own_calls(target.node):
+            if not (isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == "self"):
+                continue
+            for cand in project.by_name.get(call.func.attr, ()):
+                if cand.module is target.module \
+                        and cand.class_name == target.class_name \
+                        and cls._polls_stop(cand):
+                    return True
         return False
 
     @staticmethod

@@ -12,3 +12,8 @@ def save_manifest_raw(path, manifest):
 def append_log(path, line):
     with open(path, "ab") as f:        # raw append, no fsync/rename
         f.write(line)
+
+
+def spool_result(path, blob):
+    with open(path, "wb") as f:        # payload torn on crash
+        f.write(blob)

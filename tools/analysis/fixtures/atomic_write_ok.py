@@ -17,3 +17,23 @@ def atomic_write(path, blob):
 def scratch(path, blob):
     with open(path, "wb") as f:  # graftlint: disable=atomic-write-discipline (re-derivable scratch file)
         f.write(blob)
+
+
+class Journal:
+    """The fsync'd-append protocol (round 16): a cached append-mode
+    handle whose every record is flushed + fsync'd before return."""
+
+    def __init__(self, path):
+        self._path = path
+        self._f = None
+
+    def _handle(self):
+        if self._f is None:
+            self._f = open(self._path, "ab")
+        return self._f
+
+    def append(self, blob):
+        f = self._handle()
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())

@@ -21,3 +21,18 @@ class Daemon:
     def spawn(self):
         t = threading.Thread(target=self._run, daemon=True)
         t.start()
+
+
+class Pool:
+    """The depth-1 wiring check must not go blind: an entry whose
+    helper does NOT poll a stop event is still a leak."""
+
+    def _helper(self):
+        while True:
+            pass
+
+    def _loop(self):
+        self._helper()
+
+    def spawn(self):
+        threading.Thread(target=self._loop, daemon=True).start()

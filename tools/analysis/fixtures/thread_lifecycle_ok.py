@@ -26,3 +26,28 @@ class Igniter:
     def launch(self, fn):
         # graftlint: disable=thread-lifecycle (droppable best-effort helper; daemon dies harmlessly at exit)
         threading.Thread(target=fn, daemon=True).start()
+
+
+class Supervisor:
+    """The supervisor-restartable worker shape (round 16): the entry
+    loops forever, but its scheduling helper polls the stop event —
+    wired one call level deep, no join needed (the supervisor respawns
+    the thread on death, so a class-wide join cannot exist)."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def _next(self):
+        while not self._stop.is_set():
+            return object()
+        return None
+
+    def _worker_loop(self):
+        while True:
+            job = self._next()
+            if job is None:
+                return
+
+    def respawn(self):
+        threading.Thread(target=self._worker_loop,
+                         daemon=True).start()
