@@ -166,6 +166,38 @@ def bench_aligner():
     log(f"int32 warm (best of 2): {warm32:.2f}s "
         f"(packed speedup {warm32 / warm:.2f}x)")
 
+    # round-17 A/B grid: {bucketed, ragged} x {fixed-band, ladder} on
+    # the same pairs, with the ladder seeded from the span-asymmetry
+    # error estimate the overlap filter would provide. Breaking points
+    # must be byte-identical on every leg (the accept gate is an
+    # optimality certificate at every rung — see ops/nw._AlignStream);
+    # the recorded numbers are warm wall plus the honest work metric
+    # (wavefront_work = B x steps x band summed over every dispatched
+    # chunk) and the pad fraction that motivated the rework.
+    errs = [1.0 - min(len(q), len(t)) / max(len(q), len(t))
+            for q, t in pairs]
+
+    def align_ab(label, ragged, ladder):
+        eng = TpuAligner(num_batches=4, use_ragged=ragged,
+                         use_ladder=ladder)
+        eng.breaking_points_batch(pairs, metas, 500, errors=errs)  # cold
+        eng.stats = {k: 0 for k in eng.stats}
+        t0 = time.perf_counter()
+        got = eng.breaking_points_batch(pairs, metas, 500, errors=errs)
+        dt = time.perf_counter() - t0
+        assert all(np.array_equal(a, b) for a, b in zip(got, bps)), \
+            f"breaking points diverged on {label}"
+        log(f"aligner A/B ({label}): {dt:.2f}s "
+            f"work={eng.stats['wavefront_work']} "
+            f"pack={eng.pack_metrics()}")
+        return dt, dict(eng.stats), eng.pack_metrics()
+
+    t_bf, s_bf, p_bf = align_ab("bucketed+fixed-band, the r16 path",
+                                False, False)
+    t_bl, s_bl, p_bl = align_ab("bucketed+ladder", False, True)
+    t_rf, s_rf, p_rf = align_ab("ragged+fixed-band", True, False)
+    t_rl, s_rl, p_rl = align_ab("ragged+ladder, the default", True, True)
+
     # banded DP cell-updates/s: each wavefront step updates band/2 lanes
     # per pair; approximate with the bucket each pair landed in
     cells = 0
@@ -187,6 +219,23 @@ def bench_aligner():
         "aligner_host_agreement": round(agree, 4),
         "aligner_banded_gcups": round(gcups, 2),
         "aligner_banded_gcups_int32": round(cells / warm32 / 1e9, 2),
+        # the round-17 occupancy grid (byte-identical on every leg):
+        # ragged speedup at fixed band, ladder work reduction at fixed
+        # packing, and the default-path occupancy
+        "align_ragged_speedup": round(t_bf / t_rf, 3),
+        "align_ladder_speedup": round(t_bf / t_bl, 3),
+        "align_ladder_step_reduction": round(
+            1.0 - s_bl["wavefront_work"] / max(1, s_bf["wavefront_work"]),
+            4),
+        "align_work_reduction": round(
+            1.0 - s_rl["wavefront_work"] / max(1, s_bf["wavefront_work"]),
+            4),
+        "align_pad_fraction": p_rl["align_pad_fraction"],
+        "align_pad_fraction_bucketed_fixed": p_bf["align_pad_fraction"],
+        "align_ab_wall_s": {"bucketed_fixed": round(t_bf, 3),
+                            "bucketed_ladder": round(t_bl, 3),
+                            "ragged_fixed": round(t_rf, 3),
+                            "ragged_ladder": round(t_rl, 3)},
         "aligner_stats": dict(aligner.stats),
     }
 
@@ -414,7 +463,11 @@ def bench_pipeline():
             # run boundary: each bench leg reports its own registry
             # numbers (retrace below), not the previous leg's
             from racon_tpu.obs import metrics as obs_metrics
+            from racon_tpu.obs import trace as obs_trace
             obs_metrics.clear_run()
+            # arm the span timers (no ring buffers) so the init
+            # breakdown's dispatch-vs-fetch split is measured, not 0
+            obs_trace.activate(tracing=False)
             t0 = _time.perf_counter()
             p = create_polisher(rp, pp, cp, num_threads=8,
                                 aligner_backend=backend,
@@ -454,6 +507,10 @@ def bench_pipeline():
                                           truths[0][:probe])
         return dict(gen_s=gen_s, init_s=init_s, polish_s=polish_s,
                     total_s=total_s, stats=stats, timings=dict(p.timings),
+                    align_stats=dict(getattr(p.aligner, "stats", {})),
+                    align_pack=(p.aligner.pack_metrics()
+                                if hasattr(p.aligner, "pack_metrics")
+                                else {}),
                     retrace=retrace, err_after=err_after,
                     err_before=err_before, probe=probe,
                     n_polished=len(polished), pol0=pol0)
@@ -483,6 +540,40 @@ def bench_pipeline():
             "pipeline_fused_vs_split": round(
                 tpu["total_s"] / fused["total_s"], 3),
         }
+    # round-17 aligner A/B: the same pipeline with the ragged align
+    # stream and band ladder DISABLED (the r16 aligner path), at fixed
+    # output bytes — records the acceptance metric: total banded
+    # wavefront work (B x steps x band summed over every dispatched
+    # chunk and rung) must drop vs the fixed-band bucketed path, with
+    # the pad fraction reported alongside
+    align_ab_metrics = {}
+    log(f"pipeline bench: {mbp} Mbp fixed-band bucketed aligner A/B...")
+    os.environ["RACON_TPU_ALIGN_RAGGED"] = "0"
+    os.environ["RACON_TPU_BAND_LADDER"] = "0"
+    try:
+        fixed = run_once(mbp, seed=23, backend="tpu", batches=4)
+    finally:
+        os.environ.pop("RACON_TPU_ALIGN_RAGGED", None)
+        os.environ.pop("RACON_TPU_BAND_LADDER", None)
+    assert fixed["pol0"] == tpu["pol0"], \
+        "fixed-band bucketed aligner A/B diverged from the default path"
+    work_fixed = max(1, fixed["align_stats"].get("wavefront_work", 0))
+    work_def = tpu["align_stats"].get("wavefront_work", 0)
+    align_ab_metrics = {
+        "pipeline_align_work": work_def,
+        "pipeline_align_work_fixed": work_fixed,
+        "pipeline_align_work_reduction": round(
+            1.0 - work_def / work_fixed, 4),
+        "pipeline_align_pad_fraction":
+            tpu["align_pack"].get("align_pad_fraction", 0.0),
+        "pipeline_align_pad_fraction_fixed":
+            fixed["align_pack"].get("align_pad_fraction", 0.0),
+        "pipeline_align_ab_total_s": round(fixed["total_s"], 2),
+    }
+    log(f"pipeline align A/B: work {work_fixed} -> {work_def} "
+        f"({align_ab_metrics['pipeline_align_work_reduction']:.1%} "
+        f"reduction), output byte-identical")
+
     cpu_mbp = min(1.0, mbp)
     log(f"pipeline bench: {cpu_mbp} Mbp CPU-engine baseline...")
     cpu = run_once(cpu_mbp, seed=29, backend="cpu", batches=1)
@@ -506,6 +597,7 @@ def bench_pipeline():
         "pipeline_retrace": tpu["retrace"],
         "pipeline_mbp_per_sec": round(tput, 4),
         **fused_metrics,
+        **align_ab_metrics,
         "pipeline_cpu_mbp": cpu_mbp,
         "pipeline_cpu_total_s": round(cpu["total_s"], 2),
         "pipeline_cpu_mbp_per_sec": round(cput, 4),

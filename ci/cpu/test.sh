@@ -44,6 +44,16 @@ python -m pytest tests/test_columnar_init.py tests/test_window.py -q
 python -m pytest tests/test_ragged.py -q
 RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
   python -m pytest tests/test_ragged.py -q
+# alignment-occupancy shard (fail-fast, round 17): the {bucketed,
+# ragged} x {fixed-band, ladder} byte-identity grid for the ALIGNER —
+# ragged pair packing (_AlignStream), the adaptive band ladder with
+# escalation re-batching, stream-feed invariance, OOM reduce_capacity
+# re-dispatch parity, the align warm-up cache claim and the
+# align.dispatch stall ladder walk — then again under the sanitizer so
+# the int32 shadow leg proves the SWAR-packed walk kernel
+python -m pytest tests/test_align_stream.py -q
+RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
+  python -m pytest tests/test_align_stream.py -q
 # streaming shard-run smoke (fail-fast): the invariance suite —
 # including the 2-shard/3-shard byte-identity checks and the
 # SIGKILL-then---resume round trip — before anything slow runs
@@ -94,6 +104,7 @@ python -m pytest tests/test_obs.py -q
 python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
   --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
+  --ignore=tests/test_align_stream.py \
   --ignore=tests/test_obs.py --ignore=tests/test_faults.py \
   --ignore=tests/test_serve.py --ignore=tests/test_serve_recovery.py \
   --ignore=tests/test_topology.py --ignore=tests/test_parallel.py

@@ -376,6 +376,19 @@ class Polisher:
         msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
         need = [o for o in overlaps
                 if not o.cigar and o.breaking_points is None]
+        # dispatch-vs-fetch attribution (round 17): the round-11 span
+        # timers already measure both halves — snapshot them around the
+        # phase so pipeline_init_breakdown can say whether the 85s of
+        # align_s is host packing/dispatch or blocking device fetches.
+        # Read THIS THREAD's mirror when one is armed (chip workers set
+        # a device.<ordinal>. timer prefix): the unprefixed timers are
+        # process-global, so concurrent chip workers' spans would
+        # cross-contaminate each shard's reported split
+        from ..obs import trace as obs_trace
+        scope = ((metrics.get_scope() or "")
+                 + (obs_trace.get_timer_prefix() or ""))
+        t_disp0 = metrics.timer_s(scope + "align.dispatch")
+        t_fetch0 = metrics.timer_s(scope + "align.fetch")
         # sanitizer: the overlap-alignment phase compiles one kernel set
         # per (bucket, batch) shape — a per-chunk recompile is a
         # regression this budget catches (no-op unless RACON_TPU_SANITIZE).
@@ -388,6 +401,10 @@ class Polisher:
                                        "racon_tpu.parallel")):
             self._align_need(need, log, msg)
         self.timings["align_s"] = round(time.perf_counter() - t_align, 3)
+        self.timings["align_dispatch_s"] = round(
+            metrics.timer_s(scope + "align.dispatch") - t_disp0, 3)
+        self.timings["align_fetch_s"] = round(
+            metrics.timer_s(scope + "align.fetch") - t_fetch0, 3)
 
         t_decode = time.perf_counter()
         # the span covers the whole host decode phase — zero-length on
@@ -423,6 +440,15 @@ class Polisher:
             # instead of CIGARs (~2 bits per base) — the host link's
             # bandwidth, not the DP, bounded the aligner.
             chunk = 65536
+            # ragged align stream (round 17): the slices FEED one
+            # session, so packing/dispatch/fetch pipeline across slice
+            # boundaries (the per-slice drain used to idle the device
+            # at every 64k boundary) and each pair's band seeds from
+            # its overlap's filter-time error estimate
+            mk = getattr(self.aligner, "bp_stream", None)
+            sess = mk(self.window_length, total=len(need),
+                      progress=lambda d, t: log.bar_to(msg, d, t)) \
+                if mk is not None else None
             for begin in range(0, len(need), chunk):
                 part = need[begin:begin + chunk]
                 pairs = [(o.query_span_bytes(self.sequences),
@@ -430,12 +456,20 @@ class Polisher:
                 metas = [(o.t_begin,
                           o.q_length - o.q_end if o.strand else o.q_begin)
                          for o in part]
+                errs = [o.error for o in part]
+                if sess is not None:
+                    sess.feed(pairs, metas, errs)
+                    continue
                 base = begin
                 bps = self.aligner.breaking_points_batch(
                     pairs, metas, self.window_length,
                     progress=lambda d, t: log.bar_to(msg, base + d,
-                                                     len(need)))
+                                                     len(need)),
+                    errors=errs)
                 for o, bp in zip(part, bps):
+                    o.breaking_points = bp
+            if sess is not None:
+                for o, bp in zip(need, sess.finish()):
                     o.breaking_points = bp
         else:
             # host path: bounded chunks keep transient span copies O(chunk)

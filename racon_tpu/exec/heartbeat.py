@@ -43,16 +43,21 @@ def retrace_summary(scope: str = "") -> str:
 
 
 def pack_summary_str(scope: str = "") -> str:
-    """Real packing occupancy of the consensus pair arenas (round 10):
-    occupied/total lanes and mean windows per dispatched group, derived
+    """Real packing occupancy of the consensus pair arenas (round 10)
+    and the aligner wavefront arenas (round 17): occupied/total lanes,
+    mean windows per dispatched group and align chunk count, derived
     from the registry counters (``-`` before any launch); ``scope``
     renders one service job's numbers."""
     pack = metrics.pack_summary(scope)
-    if not pack["groups"]:
-        return "-"
-    return (f"{pack['pack_efficiency']:.2f}eff,"
-            f"{pack['windows_per_group']:.0f}w/g,"
-            f"{pack['groups']}g")
+    parts = []
+    if pack["groups"]:
+        parts.append(f"{pack['pack_efficiency']:.2f}eff,"
+                     f"{pack['windows_per_group']:.0f}w/g,"
+                     f"{pack['groups']}g")
+    if pack["align_chunks"]:
+        parts.append(f"a:{pack['align_pack_efficiency']:.2f}eff,"
+                     f"{pack['align_chunks']}c")
+    return ";".join(parts) if parts else "-"
 
 
 def queue_summary_str(scope: str = "") -> str:

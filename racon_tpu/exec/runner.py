@@ -1072,9 +1072,10 @@ class ShardRunner:
                         worker.reduce_capacity(mesh=use_mesh):
                     att["action"] = "reduce-capacity"
                     warn(f"shard {si} device OOM ({err}) — halved "
-                         f"worker {worker.worker}'s consensus "
-                         f"arena/group capacity, re-dispatching on the "
-                         f"device")
+                         f"worker {worker.worker}'s engine "
+                         f"arena/group capacity (consensus pair arena "
+                         f"+ align dirs budget), re-dispatching on "
+                         f"the device")
                 elif not tier_cpu:
                     tier_cpu = True
                     att["action"] = "cpu-retry"

@@ -26,7 +26,7 @@ tests (and for operators reproducing a production fault). The grammar::
     RACON_TPU_FAULTS=site:kind[@N][*][%P],site:kind...
 
 - *site* — a named injection point (:data:`KNOWN_SITES`): the
-  consensus dispatch, the aligner fetch, the part-file write, the
+  consensus dispatch, the aligner dispatch and fetch, the part-file write, the
   manifest write, the worker itself (``worker.kill`` SIGKILLs the
   process — the chaos soak's crash source), ``exec.polish`` (the
   per-shard polish entry the legacy hook targets), ``serve.polish``
@@ -146,7 +146,8 @@ def backoff_s(base: float, k: int, token: str) -> float:
 
 # --------------------------------------------------------------- injection
 
-KNOWN_SITES = ("consensus.dispatch", "align.fetch", "part.write",
+KNOWN_SITES = ("consensus.dispatch", "align.dispatch", "align.fetch",
+               "part.write",
                "manifest.write", "worker.kill", "exec.polish",
                "serve.polish", "serve.journal", "serve.socket",
                "serve.slot", "server.kill")

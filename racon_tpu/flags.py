@@ -63,6 +63,20 @@ REGISTRY: Dict[str, Flag] = _declare([
     Flag("RACON_TPU_DYNBOUND", "1", "bool",
          "Per-block dynamic sweep bounds in the Pallas kernels; set 0 to "
          "run every block at the static bound for A/B measurement."),
+    Flag("RACON_TPU_ALIGN_RAGGED", "1", "bool",
+         "Ragged pair packing in the device aligner: pairs bucket by "
+         "their own sweep cost and chunks greedy-fill a fixed "
+         "direction-matrix arena through the streaming _AlignStream "
+         "session (double-buffered dispatch/fetch) instead of one "
+         "batch cap per length bucket; set 0 to force the bucketed "
+         "wave driver for A/B measurement."),
+    Flag("RACON_TPU_BAND_LADDER", "1", "bool",
+         "Adaptive alignment band ladder: each pair's starting band is "
+         "seeded from its overlap's estimated divergence (quantized to "
+         "a 1.5x-step rung ladder from 64 up to its bucket band) and "
+         "escapees re-dispatch batched at the rung >= 2x the failed "
+         "band; set 0 to start every pair at its bucket's full band "
+         "for A/B measurement."),
     Flag("RACON_TPU_RAGGED", "1", "bool",
          "Ragged window packing in the consensus engine: windows bucket "
          "by their own size and groups greedy-fill a fixed lane arena "
@@ -143,7 +157,8 @@ REGISTRY: Dict[str, Flag] = _declare([
     Flag("RACON_TPU_FAULTS", "", "str",
          "Seeded site-addressed fault injection: "
          "'site:kind[@N][*][%P],...' — sites consensus.dispatch / "
-         "align.fetch / part.write / manifest.write / worker.kill / "
+         "align.dispatch / align.fetch / part.write / manifest.write / "
+         "worker.kill / "
          "exec.polish / serve.polish / serve.journal / serve.socket / "
          "serve.slot / server.kill; kinds io, enospc, oom, err, "
          "stall, kill; @N arms on the Nth hit, '*' keeps firing, %P "

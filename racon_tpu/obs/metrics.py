@@ -202,17 +202,29 @@ def pack_summary(scope: str = "") -> Dict[str, Number]:
     """Pair-arena occupancy derived from the ``consensus.*`` counters
     the device engine publishes per launch — the registry twin of
     ``TpuPoaConsensus.pack_metrics()``, cumulative since the last run
-    boundary (:func:`clear_run`).  ``scope`` reads one job's numbers."""
+    boundary (:func:`clear_run`) — plus the aligner's wavefront-arena
+    occupancy (round 17, the ``align.*`` counters mirrored from every
+    dispatched chunk; the registry twin of ``TpuAligner.pack_metrics``).
+    ``scope`` reads one job's numbers."""
     with _lock:
         tot = _counters.get(scope + "consensus.lanes_total", 0)
         occ = _counters.get(scope + "consensus.lanes_occupied", 0)
         grp = _counters.get(scope + "consensus.groups", 0)
         wins = _counters.get(scope + "consensus.group_windows", 0)
+        a_tot = _counters.get(scope + "align.lanes_total", 0)
+        a_occ = _counters.get(scope + "align.lanes_occupied", 0)
+        a_chunks = _counters.get(scope + "align.chunks", 0)
+        a_wasted = _counters.get(scope + "align.steps_wasted", 0)
     eff = occ / tot if tot else 0.0
+    a_eff = a_occ / a_tot if a_tot else 0.0
     return {"pack_efficiency": round(eff, 4),
             "pad_fraction": round(1.0 - eff, 4) if tot else 0.0,
             "windows_per_group": round(wins / grp, 2) if grp else 0.0,
-            "groups": grp}
+            "groups": grp,
+            "align_pack_efficiency": round(a_eff, 4),
+            "align_pad_fraction": round(1.0 - a_eff, 4) if a_tot else 0.0,
+            "align_chunks": a_chunks,
+            "align_steps_wasted": a_wasted}
 
 
 def queue_summary(scope: str = "") -> Dict[str, Number]:

@@ -42,7 +42,14 @@ from . import metrics
 # across a server restart, results served from the CRC-verified spool,
 # slot-supervision restarts/quarantines).  Server-level, unscoped;
 # all zeros for plain CLI/exec runs.
-SCHEMA_VERSION = 5
+# v6 (round 17): the "pack" section grew required ALIGNER occupancy
+# keys (align_pack_efficiency / align_pad_fraction / align_chunks /
+# align_steps_wasted — wavefront-arena occupancy of every dispatched
+# align chunk, replacing the blind device/band_escalated counts as the
+# aligner's efficiency signal), and "dispatch_fetch"'s align split now
+# also lands in Polisher.timings (align_dispatch_s / align_fetch_s in
+# the phases dict).
+SCHEMA_VERSION = 6
 
 KINDS = ("cli", "exec", "job")
 
@@ -71,7 +78,8 @@ _TOP = {
 
 _QUEUE_KEYS = ("depth", "producer_wait_s", "consumer_wait_s", "stall_s")
 _PACK_KEYS = ("pack_efficiency", "pad_fraction", "windows_per_group",
-              "groups")
+              "groups", "align_pack_efficiency", "align_pad_fraction",
+              "align_chunks", "align_steps_wasted")
 _RECOVERY_KEYS = ("recovered_jobs", "requeued_jobs",
                   "served_from_spool", "spool_corrupt",
                   "journal_replayed", "journal_records",

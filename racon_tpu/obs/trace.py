@@ -174,6 +174,14 @@ def track(name: str):
     return _Track(name)
 
 
+def get_timer_prefix():
+    """The CURRENT THREAD's span-timer mirror prefix (None when unset)
+    — readers that want an uncontaminated per-thread timer (e.g. the
+    polisher's align dispatch/fetch split under concurrent chip
+    workers) prepend this to the span name."""
+    return getattr(_tls, "timer_prefix", None)
+
+
 def set_timer_prefix(prefix) -> None:
     """Mirror the CURRENT THREAD's span timers under ``prefix + name``
     in addition to the plain span name (None clears). The in-process

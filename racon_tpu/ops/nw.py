@@ -46,6 +46,39 @@ BUCKETS: Tuple[Tuple[int, int], ...] = (
 # Expected divergence used to pick the initial band (escalation corrects
 # underestimates; ONT reads of the reference's era run 15-30%).
 TYPICAL_DIVERGENCE = 0.25
+# Adaptive band-ladder rungs (round 17): a pair's starting band is seeded
+# from its overlap's estimated divergence, quantized to this 1.5x-step
+# geometric ladder and capped at the pair's bucket band (the terminal
+# rung, so the accept/reject SET is identical to the fixed-band path's —
+# part of the byte-identity contract). DP work is linear in band, so a
+# pair accepted two rungs down sheds most of its wavefront lanes;
+# escapees re-dispatch batched at the rung >= 2x their failed band (the
+# reference host's band doubling, but batched — cudaaligner sizes
+# per-alignment work from each pair's own length/band the same way,
+# src/cuda/cudaaligner.cpp:39-44). Every rung keeps the kernels' static
+# constraints (band % 8 == 0, band/2 even); each distinct rung is one
+# extra compile per bucket, remembered by the persistent XLA cache.
+BAND_RUNGS = (64, 96, 128, 192, 256, 384, 512, 768, 1024, 1536, 2048,
+              3072, 4096)
+# The adaptive half of the ladder: seeds trust the run's OBSERVED
+# clean-walk score divergence once this many pairs have resolved — the
+# overlap filter's span-asymmetry error only sees net indels, so a
+# substitution-heavy run would otherwise seed low and escape every pair.
+ADAPT_MIN_PAIRS = 256
+# Cold-start probe batch: the ragged stream seeds/dispatches/fetches
+# this many leading pairs FIRST (one pipeline bubble), so every later
+# seed uses observed divergence rather than the blind span proxy.
+ALIGN_PROBE_PAIRS = 1024
+# Bound on pairs per device chunk: the ragged packer's memory-budget cap
+# can reach 6 figures for short-pair classes, but each pair also pins a
+# transient host span copy until its chunk is fetched — the same
+# O(slice) contract the polisher's 64k overlap slices enforce.
+MAX_CHUNK_PAIRS = 65536
+# Companion bound on the stream's in-flight PAIRS: short-pair chunks are
+# tiny in direction-matrix bytes (the budget that normally forces
+# fetches), so without this a 10M-overlap short-read run would pin
+# millions of unresolved span copies before the byte budget ever bit.
+MAX_INFLIGHT_PAIRS = 4 * MAX_CHUNK_PAIRS
 # Upper bound on the packed direction-matrix bytes held across in-flight
 # device batches (v5e has 16 GiB HBM; the matrix never leaves the
 # device). Small caps fragment long-bucket batches into many chunks and
@@ -208,8 +241,8 @@ def _walk_op(pk, i, j, *, c, RB, S, U):
     return op, di, dj
 
 
-@functools.partial(jax.jit, static_argnames=("band",))
-def _walk_ops_kernel(packed, n, m, *, band: int):
+@functools.partial(jax.jit, static_argnames=("band", "swar"))
+def _walk_ops_kernel(packed, n, m, *, band: int, swar: bool = False):
     """On-device traceback: vmapped pointer chase over the packed direction
     matrix (which never leaves HBM — downloading it dominated wall-clock
     otherwise). Emits one op code per step, consumed backwards from (n, m):
@@ -219,7 +252,16 @@ def _walk_ops_kernel(packed, n, m, *, band: int):
     ``steps`` bound, default ``2*max_len``). Returns unpacked
     ``(ops [B, steps] u8, fi, fj)`` — stays on device for the consensus
     vote path; the aligner packs via :func:`_traceback_kernel`.
-    """
+
+    ``swar`` runs the SWAR-packed variant (the round-6 layout extended
+    to the walk, the ROADMAP open item): the ``(i, j)`` walk state
+    travels as ONE int32 halfword pair — positions are bounded by the
+    bucket cap (16384 < 2^15, the same ``swar.swar_fits`` ceiling the
+    forward kernel's guard enforces), so the scan carry and its
+    per-step update halve. Decode math is shared with the unpacked path
+    (:func:`_walk_op`), so the op stream is **byte-identical**; the
+    sanitizer's int32 shadow execution covers it (the shadow leg runs
+    ``swar=False`` end to end)."""
     W = band
     c = W // 2
     U = W // 2
@@ -228,6 +270,16 @@ def _walk_ops_kernel(packed, n, m, *, band: int):
     flat = packed.reshape(B, S * RB)
 
     def per_pair(pk, nn, mm):
+        if swar:
+            def step(carry, _):
+                ij = carry  # (i << 16) | j, both < 2^15 (swar_fits)
+                op, di, dj = _walk_op(pk, ij >> 16, ij & 0xFFFF,
+                                      c=c, RB=RB, S=S, U=U)
+                return ij - ((di << 16) | dj), op
+
+            ijf, ops = lax.scan(step, (nn << 16) | mm, None, length=S)
+            return ops, ijf >> 16, ijf & 0xFFFF
+
         def step(carry, _):
             i, j = carry
             op, di, dj = _walk_op(pk, i, j, c=c, RB=RB, S=S, U=U)
@@ -239,12 +291,14 @@ def _walk_ops_kernel(packed, n, m, *, band: int):
     return jax.vmap(per_pair)(flat, n, m)
 
 
-@functools.partial(jax.jit, static_argnames=("max_len", "band"))
-def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int):
+@functools.partial(jax.jit, static_argnames=("max_len", "band", "swar"))
+def _traceback_kernel(packed, score, n, m, *, max_len: int, band: int,
+                      swar: bool = False):
     """Aligner-facing traceback: walks on device, then packs the op codes
     2-bit x 4-per-byte so one host round-trip fetches everything (the
-    tunnel to the device has ~0.2s per-transfer latency)."""
-    ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band)
+    tunnel to the device has ~0.2s per-transfer latency). ``swar``
+    forwards to the packed-carry walk (byte-identical op stream)."""
+    ops, fi, fj = _walk_ops_kernel(packed, n, m, band=band, swar=swar)
     return _pack_ops(ops), score, fi, fj
 
 
@@ -278,7 +332,8 @@ def align_chain(qrp, tp, n, m, *, max_len: int, band: int, steps: int = 0,
     packed, score = _nw_wavefront_kernel(qrp, tp, n, m,
                                          max_len=max_len, band=band,
                                          steps=steps, swar=use_swar)
-    return _traceback_kernel(packed, score, n, m, max_len=max_len, band=band)
+    return _traceback_kernel(packed, score, n, m, max_len=max_len,
+                             band=band, swar=use_swar)
 
 
 def _row_layout(n, m, *, max_len: int, band: int):
@@ -462,7 +517,8 @@ class TpuAligner(PallasDispatchMixin):
     def __init__(self, fallback=None, buckets=BUCKETS,
                  max_dirs_bytes=MAX_DIRS_BYTES, mesh=None,
                  num_batches: int = 1, use_swar: bool = True,
-                 device=None):
+                 device=None, use_ragged=None, use_ladder=None):
+        from .. import flags
         self.fallback = fallback
         self.buckets = buckets
         self.max_dirs_bytes = max_dirs_bytes
@@ -483,12 +539,94 @@ class TpuAligner(PallasDispatchMixin):
         # availability probe (swar.swar_ok) — both identical-output, so
         # this knob only exists for A/B measurement and escape hatches.
         self.use_swar = use_swar
+        # ragged pair packing (round 17, on by default off-mesh; ctor
+        # arg or RACON_TPU_ALIGN_RAGGED=0 restores the bucketed wave
+        # driver): pairs greedy-fill a fixed direction-matrix arena by
+        # their own sweep cost through the streaming _AlignStream
+        # session — the aligner analog of poa._ConsensusStream
+        self.use_ragged = (flags.get_bool("RACON_TPU_ALIGN_RAGGED")
+                           if use_ragged is None else use_ragged)
+        # adaptive band ladder (round 17; RACON_TPU_BAND_LADDER=0 for
+        # A/B): seed each pair's band from its overlap's estimated
+        # divergence, escalate escapees batched — see BAND_RUNGS
+        self.use_ladder = (flags.get_bool("RACON_TPU_BAND_LADDER")
+                           if use_ladder is None else use_ladder)
+        # memory backpressure (round 12 ladder parity, round 17): a
+        # device RESOURCE_EXHAUSTED halves the effective direction-
+        # matrix budget (reduce_capacity) and the chunk re-dispatches —
+        # grouping never changes output bytes, only launch size
+        self.capacity_scale = 1
+        # shapes already submitted for warm-up compilation (the
+        # resident service warms per admitted job; repeats are free)
+        self._warmed_shapes: set = set()
+        # adaptive ladder state: [count, sum, sum_sq] of the realized
+        # divergence (score / longer span) of every accepted pair
+        self._div_obs = [0, 0.0, 0.0]
         # sanitizer: per-aligner shadow sampler (first chunk always)
         from .. import sanitize
         self._shadow = sanitize.ShadowSampler()
+        # occupancy telemetry (round 17): chunks/lanes_occupied/
+        # lanes_total count every dispatched wavefront arena (occupied
+        # = sum of real pairs' n+m anti-diagonals, total = B x steps
+        # per launch); steps_wasted is their gap and wavefront_work
+        # (total x band, summed over rungs) is the banded-DP cost the
+        # bench A/B grid records — replacing the blind device/
+        # band_escalated counts as the aligner's efficiency signal
         self.stats = {"device": 0, "fallback_length": 0, "fallback_band": 0,
                       "band_escalated": 0, "swar_chunks": 0,
-                      "swar_guard_int32": 0}
+                      "swar_guard_int32": 0, "chunks": 0,
+                      "lanes_occupied": 0, "lanes_total": 0,
+                      "steps_wasted": 0, "wavefront_work": 0,
+                      "ladder_narrow": 0}
+
+    # the floor keeps OOM backpressure from shrinking chunks below the
+    # point where per-chunk fixed costs dominate (mirrors the consensus
+    # engine's _MAX_CAPACITY_SCALE contract)
+    _MAX_CAPACITY_SCALE = 16
+
+    @property
+    def dirs_budget_cap(self) -> int:
+        """Total in-flight direction-matrix byte budget under the
+        current OOM-backpressure scale (``max_dirs_bytes`` at 1). The
+        floor derives from the CONFIGURED budget at the maximum scale —
+        an absolute floor would both override small caller-sized
+        budgets and let reduce_capacity() report shrinkage it no
+        longer delivers (the exec ladder would re-dispatch at
+        unchanged memory and OOM again)."""
+        return max(1, self.max_dirs_bytes // self.capacity_scale)
+
+    def chunk_dirs_budget(self) -> int:
+        """Per-chunk direction-matrix budget: the in-flight budget split
+        over the pipeline depth — shared by the bucketed wave driver,
+        the ragged stream's greedy fill and the warm-up shape estimate
+        so all three account identically."""
+        return max(1, self.dirs_budget_cap // self.num_batches)
+
+    def reduce_capacity(self) -> bool:
+        """Halve the direction-matrix arena (device-OOM backpressure,
+        the exec ladder's ``reduce-capacity`` rung). Returns False once
+        at the floor — the ladder then falls through to the CPU
+        engines. Chunk grouping never changes output bytes (pairs are
+        independent), so a reduced re-dispatch is byte-identical."""
+        if self.capacity_scale >= self._MAX_CAPACITY_SCALE:
+            return False
+        self.capacity_scale *= 2
+        metrics.set_gauge("aligner.capacity_scale", self.capacity_scale)
+        metrics.inc("faults.backpressure_halvings")
+        return True
+
+    def pack_metrics(self) -> dict:
+        """Derived occupancy view of :attr:`stats` (the aligner twin of
+        ``TpuPoaConsensus.pack_metrics``): ``align_pad_fraction`` =
+        wavefront-arena lanes spent on padding (batch pow2 pad + dead
+        anti-diagonals past each pair's own n+m), ``align_chunks`` =
+        dispatched device chunks."""
+        tot = self.stats.get("lanes_total", 0)
+        eff = self.stats.get("lanes_occupied", 0) / tot if tot else 0.0
+        return {"align_pack_efficiency": round(eff, 4),
+                "align_pad_fraction": round(1.0 - eff, 4) if tot else 0.0,
+                "align_chunks": self.stats.get("chunks", 0),
+                "align_steps_wasted": self.stats.get("steps_wasted", 0)}
 
     def _swar_choice(self, max_len: int) -> bool:
         """Packed-lane eligibility for a bucket: the global availability
@@ -527,40 +665,179 @@ class TpuAligner(PallasDispatchMixin):
                     fallback_bi = bi
         return fallback_bi
 
+    def _observe_divergence(self, scores, maxlens) -> None:
+        """Feed accepted pairs' realized edit divergence (score over the
+        longer span) into the run's running estimate — the adaptive half
+        of the band ladder."""
+        cnt, s, s2 = self._div_obs
+        d = np.asarray(scores, dtype=np.float64) / np.maximum(
+            np.asarray(maxlens, dtype=np.float64), 1.0)
+        self._div_obs = [cnt + d.size, s + float(d.sum()),
+                         s2 + float((d * d).sum())]
+
+    def _adaptive_divergence(self):
+        """Observed-divergence upper estimate (mean + 2 sigma) once
+        enough pairs have resolved; None while cold."""
+        cnt, s, s2 = self._div_obs
+        if cnt < ADAPT_MIN_PAIRS:
+            return None
+        mean = s / cnt
+        var = max(0.0, s2 / cnt - mean * mean)
+        return mean + 2.0 * var ** 0.5
+
+    def _est_divergence(self, err) -> float:
+        """Divergence estimate for the band ladder. COLD (no resolved
+        pairs yet): ``TYPICAL_DIVERGENCE`` — deliberately conservative,
+        so a run never gambles narrow bands on the span-asymmetry proxy
+        alone (the overlap filter's ``o.error`` only sees NET indels; a
+        substitution-heavy run would seed low and escape every pair).
+        WARM: the observed divergence (:meth:`_adaptive_divergence`),
+        raised per pair by the span proxy (2x + 5% margin) when that
+        reads higher. An underestimate costs one batched re-dispatch
+        (band escape), never a wrong alignment — the accept gate is the
+        same optimality certificate at every rung."""
+        ad = self._adaptive_divergence()
+        if ad is None:
+            return TYPICAL_DIVERGENCE
+        proxy = 0.0 if err is None else 2.0 * float(err) + 0.05
+        return min(TYPICAL_DIVERGENCE, max(proxy, ad))
+
+    def _seed_geometry(self, qlen: int, tlen: int, err=None,
+                       record: bool = True):
+        """Starting ``(bucket_index, band)`` for one pair: the fixed
+        path's bucket, at the narrowest ladder rung the divergence
+        estimate admits (the bucket's full band with the ladder off, or
+        when no rung is plausibly wide enough). None -> host fallback,
+        exactly the fixed path's length-reject set. ``record=False``
+        skips the ladder telemetry — the warm-up's shape ESTIMATE must
+        not count phantom pairs (nor write the stats dict from the
+        service's admission thread)."""
+        bi = self._bucket_index(qlen, tlen)
+        if bi is None:
+            return None
+        bucket_band = self.buckets[bi][1]
+        if not self.use_ladder:
+            return (bi, bucket_band)
+        need = abs(qlen - tlen) + 16
+        want = need + int(self._est_divergence(err) * max(qlen, tlen))
+        for rung in BAND_RUNGS:
+            if rung >= bucket_band:
+                break
+            if want <= rung // 2:
+                if record:
+                    self.stats["ladder_narrow"] += 1
+                    metrics.inc("aligner.ladder_narrow")
+                return (bi, rung)
+        return (bi, bucket_band)
+
+    def _chunk_cap(self, steps: int, band: int, base: int = 1) -> int:
+        """Pairs per device chunk for one sweep geometry: the largest
+        ``base * 2^k`` batch whose direction matrix fits the per-chunk
+        budget, bounded by ``MAX_CHUNK_PAIRS`` (transient host span
+        copies). THE one cap rule — shared by the bucketed wave driver,
+        the ragged stream's greedy fill and the warm-up shape estimate,
+        so the warm-cache claim cannot drift from the live caps."""
+        raw = self.chunk_dirs_budget() // (steps * (band // 8))
+        cap = base
+        while cap * 2 <= raw and cap * 2 <= MAX_CHUNK_PAIRS:
+            cap *= 2
+        return cap
+
+    def _next_geometry(self, qlen: int, tlen: int, bi: int, band: int):
+        """Escalation after a band escape: the next ladder rung inside
+        the same bucket (skipping rungs the pair's length difference
+        already rules out), then the fixed path's bucket escalation —
+        so the ladder's terminal geometry sequence IS the fixed path's,
+        and the two reject sets coincide. None -> host fallback."""
+        bucket_band = self.buckets[bi][1]
+        if band < bucket_band:
+            # an escape means the seed was wrong, so jump, don't creep:
+            # the rung the CURRENT divergence estimate (adaptive once
+            # warm, TYPICAL when cold — conservative) says should hold,
+            # at least 2x the failed band — a 1.5x walk would waste a
+            # re-dispatch per step
+            need = abs(qlen - tlen) + 16
+            want = need + int(self._est_divergence(None)
+                              * max(qlen, tlen))
+            nb = bucket_band
+            for rung in BAND_RUNGS:
+                if rung >= 2 * band and rung < bucket_band \
+                        and want <= rung // 2:
+                    nb = rung
+                    break
+            return (bi, nb)
+        nbi = self._bucket_index(qlen, tlen, bi + 1)
+        if nbi is None:
+            return None
+        return (nbi, self.buckets[nbi][1])
+
     # the polisher hands this backend the whole overlap stream (it buckets
     # and chunks internally) instead of pre-chunked 1024-pair slices
     wants_full_stream = True
 
     def align_batch(self, pairs: Sequence[Tuple[bytes, bytes]],
-                    progress=None) -> List[str]:
+                    progress=None, errors=None) -> List[str]:
         """CIGAR strings for every pair (test/bench surface; the pipeline
         uses :meth:`breaking_points_batch`, which never fetches the op
-        stream)."""
-        return self._drive(pairs, progress, None)
+        stream). ``errors`` optionally carries per-pair divergence
+        estimates for the band ladder (overlap ``error`` values)."""
+        return self._drive(pairs, progress, None, errors)
 
     def breaking_points_batch(self, pairs, metas, window_length: int,
-                              progress=None):
+                              progress=None, errors=None):
         """Per-window breaking points for every (query-span, target-span)
         pair — the production surface behind
         ``Polisher.find_overlap_breaking_points``. ``metas[i]`` is the
         overlap's ``(t_begin, q_off)`` (global target start; strand-aware
-        global query offset). The walk stays on device and only ~8 bytes
-        per window boundary are fetched (:func:`_breaking_points_kernel`);
-        rejects fall back to the host aligner + the shared CIGAR walker.
-        Returns one **columnar** int32 ndarray of shape (k, 4) per pair —
-        rows of (t_first, q_first, t_end_excl, q_end_excl), row-identical
-        to the walker's pairs on every path."""
-        return self._drive(pairs, progress, (window_length, metas))
+        global query offset); ``errors[i]`` (optional) its filter-time
+        ``error`` estimate, seeding the band ladder. The walk stays on
+        device and only ~8 bytes per window boundary are fetched
+        (:func:`_breaking_points_kernel`); rejects fall back to the host
+        aligner + the shared CIGAR walker. Returns one **columnar** int32
+        ndarray of shape (k, 4) per pair — rows of (t_first, q_first,
+        t_end_excl, q_end_excl), row-identical to the walker's pairs on
+        every path."""
+        return self._drive(pairs, progress, (window_length, metas), errors)
 
-    def _drive(self, pairs, progress, bp_meta):
+    def bp_stream(self, window_length: int, progress=None, total: int = 0):
+        """Open a ragged streaming breaking-points session (round 17):
+        ``feed()`` buckets pairs by their own sweep cost and band rung
+        and **asynchronously dispatches** greedy-filled chunks as
+        overlap slices arrive — packing/dispatch/fetch pipeline across
+        slice boundaries instead of draining per slice — and
+        ``finish()`` drains the pipeline, runs the batched band-ladder
+        escalations and the host fallback, and returns breaking points
+        for every fed pair in feed order. ``Polisher._align_need`` feeds
+        this directly. Returns None when the ragged packer is
+        unavailable (mesh runs, ``RACON_TPU_ALIGN_RAGGED=0``) — callers
+        then fall back to per-slice :meth:`breaking_points_batch`."""
+        if not self.use_ragged or self.mesh is not None:
+            return None
+        return _AlignStream(self, window_length=window_length,
+                            progress=progress, total_hint=total)
+
+    def _drive(self, pairs, progress, bp_meta, errors=None):
+        if self.use_ragged and self.mesh is None:
+            # one-feed session: the same ragged packer the polisher's
+            # streaming feed uses, so batch surfaces and the pipeline
+            # share one dispatch path (and one A/B axis)
+            sess = _AlignStream(
+                self, window_length=bp_meta[0] if bp_meta else None,
+                progress=progress, total_hint=len(pairs))
+            sess.feed(pairs, metas=bp_meta[1] if bp_meta else None,
+                      errors=errors)
+            return sess.finish()
+        return self._drive_bucketed(pairs, progress, bp_meta, errors)
+
+    def _drive_bucketed(self, pairs, progress, bp_meta, errors=None):
         # progress counts pairs whose final result is settled — escaped
-        # pairs re-enter a wider bucket and are only counted once, on
+        # pairs re-enter a wider geometry and are only counted once, on
         # their last visit; fallback/empty pairs are counted when resolved
         done_pairs = 0
         empty_bp = np.zeros((0, 4), dtype=np.int32)
         cigars: List = [("" if bp_meta is None else empty_bp)
                         for _ in range(len(pairs))]
-        by_bucket = {}
+        by_class = {}  # (bucket_index, band) -> indices
         reject: List[int] = []
         for idx, (q, t) in enumerate(pairs):
             if len(q) == 0 or len(t) == 0:
@@ -571,45 +848,53 @@ class TpuAligner(PallasDispatchMixin):
                     cigars[idx] = empty_bp  # no matches -> no breaking pts
                 done_pairs += 1
                 continue
-            bi = self._bucket_index(len(q), len(t))
-            if bi is None:
+            g = self._seed_geometry(len(q), len(t),
+                                    None if errors is None
+                                    else errors[idx])
+            if g is None:
                 reject.append(idx)
             else:
-                by_bucket.setdefault(bi, []).append(idx)
+                by_class.setdefault(g, []).append(idx)
         self.stats["fallback_length"] += len(reject)
 
-        # Band escapes retry on device with the next (wider-band) bucket —
-        # the analog of the reference host's band-doubling, but batched.
-        # All buckets of a wave share one in-flight window (num_batches
-        # deep): with num_batches > 1, chunk k+1 of any bucket is packed
-        # and dispatched while chunk k computes, hiding the tunnel's
-        # ~0.3s per-fetch round-trip; escape handling is batched per wave
-        # either way. Only escapes from the widest bucket go to the host
-        # fallback.
+        # Band escapes retry on device at the next rung (ladder) or the
+        # next wider-band bucket — the analog of the reference host's
+        # band-doubling, but batched. All classes of a wave share one
+        # in-flight window (num_batches deep): with num_batches > 1,
+        # chunk k+1 of any class is packed and dispatched while chunk k
+        # computes, hiding the tunnel's ~0.3s per-fetch round-trip;
+        # escape handling is batched per wave either way. Only escapes
+        # from the widest geometry go to the host fallback.
         from ..parallel import mesh_size
-        while by_bucket:
+        # cold-estimator eager fetch (see _AlignStream._launch): fetch
+        # the wave's first chunk immediately so the adaptive ladder
+        # seeds the rest of the wave from real scores
+        eager = (self.use_ladder
+                 and self._adaptive_divergence() is None)
+        while by_class:
             inflight = []
-            escaped = {}  # bucket -> indices that escaped its band
-            for bi in sorted(by_bucket):
+            escaped = {}  # class -> indices that escaped its band
+            for cls in sorted(by_class):
+                bi, band = cls
                 # longest first: chunks (and the Pallas kernels' 64-pair
                 # blocks within them) hold similar-length pairs, so the
                 # per-block dynamic sweep bound cuts the short blocks'
                 # dead wavefronts instead of averaging against the max
                 indices = sorted(
-                    by_bucket[bi],
+                    by_class[cls],
                     key=lambda i: -(len(pairs[i][0]) + len(pairs[i][1])))
-                max_len, band = self.buckets[bi]
+                max_len = self.buckets[bi][0]
                 # budget by the real sweep bound, not the worst case: the
                 # direction matrix is (B, steps, band/8) and steps tracks
-                # the longest pair in the bucket — budgeting 2*max_len
+                # the longest pair in the class — budgeting 2*max_len
                 # halved the chunk size (and doubled the dispatch syncs)
                 # for typical pairs well under the bucket cap (indices
                 # are sorted longest-first, so the head is the max)
                 max_nm = (len(pairs[indices[0]][0])
                           + len(pairs[indices[0]][1]))
                 steps_est = _sweep_bound(max_nm, max_len)
-                raw_cap = (self.max_dirs_bytes // self.num_batches
-                           ) // (steps_est * (band // 8))
+                raw_cap = self.chunk_dirs_budget() // (steps_est
+                                                       * (band // 8))
                 # chunks pad to mesh_size * 2^k (see _pad_batch), so cap
                 # at the largest such size to keep the memory bound honest
                 batch_cap = mesh_size(self.mesh)
@@ -619,12 +904,12 @@ class TpuAligner(PallasDispatchMixin):
                         f"mesh size {batch_cap} exceeds the direction-"
                         f"matrix memory budget ({raw_cap} pairs of bucket "
                         f"({max_len},{band}) fit in "
-                        f"{self.max_dirs_bytes // self.num_batches} "
+                        f"{self.chunk_dirs_budget()} "
                         f"bytes); lower num_batches or use a smaller mesh",
                         RuntimeWarning)
-                while batch_cap * 2 <= raw_cap:
-                    batch_cap *= 2
-                esc = escaped.setdefault(bi, [])
+                batch_cap = self._chunk_cap(steps_est, band,
+                                            base=batch_cap)
+                esc = escaped.setdefault(cls, [])
                 # keep num_batches chunks in flight so the host packs
                 # chunk k+1 while the device computes chunk k (reference
                 # analog: per-batch fill/process loops on pool threads,
@@ -635,7 +920,9 @@ class TpuAligner(PallasDispatchMixin):
                         (band, esc, self._launch_chunk(pairs, chunk,
                                                        max_len, band,
                                                        bp_meta)))
-                    if len(inflight) >= self.num_batches:
+                    if len(inflight) >= (1 if eager
+                                         else self.num_batches):
+                        eager = False
                         band0, esc0, launched = inflight.pop(0)
                         n_chunk = len(launched[0])
                         n_esc = len(esc0)
@@ -652,45 +939,54 @@ class TpuAligner(PallasDispatchMixin):
                 done_pairs += n_chunk - (len(esc0) - n_esc)
                 if progress is not None:
                     progress(done_pairs, len(pairs))
-            by_bucket = {}
-            for bi, idxs in escaped.items():
+            by_class = {}
+            for cls, idxs in escaped.items():
+                bi, band = cls
                 for idx in idxs:
                     q, t = pairs[idx]
-                    nbi = self._bucket_index(len(q), len(t), bi + 1)
-                    if nbi is None:
+                    ng = self._next_geometry(len(q), len(t), bi, band)
+                    if ng is None:
                         self.stats["fallback_band"] += 1
                         metrics.inc("aligner.fallback_band")
                         reject.append(idx)
                     else:
                         self.stats["band_escalated"] += 1
                         metrics.inc("aligner.band_escalated")
-                        by_bucket.setdefault(nbi, []).append(idx)
+                        by_class.setdefault(ng, []).append(idx)
 
-        if reject:
-            if self.fallback is None:
-                raise RuntimeError(
-                    f"{len(reject)} pairs rejected and no fallback aligner")
-            fb = self.fallback.align_batch([pairs[i] for i in reject])
-            if bp_meta is None:
-                for i, cig in zip(reject, fb):
-                    cigars[i] = cig
-            else:
-                from ..core.overlap import decode_breaking_points_batch
-                w, metas = bp_meta
-                arrs = decode_breaking_points_batch(
-                    fb, [metas[i][1] for i in reject],
-                    [metas[i][0] for i in reject],
-                    [metas[i][0] + len(pairs[i][1]) for i in reject], w)
-                for i, arr in zip(reject, arrs):
-                    cigars[i] = arr
+        self._resolve_rejects(pairs, reject, cigars, bp_meta)
         if progress is not None and done_pairs < len(pairs):
             progress(len(pairs), len(pairs))
         return cigars
+
+    def _resolve_rejects(self, pairs, reject, results, bp_meta) -> None:
+        """Host-fallback resolution for length/band rejects, shared by
+        the bucketed wave driver and the ragged stream (``pairs`` only
+        needs ``pairs[i]`` indexing — a list or a slot dict)."""
+        if not reject:
+            return
+        if self.fallback is None:
+            raise RuntimeError(
+                f"{len(reject)} pairs rejected and no fallback aligner")
+        fb = self.fallback.align_batch([pairs[i] for i in reject])
+        if bp_meta is None:
+            for i, cig in zip(reject, fb):
+                results[i] = cig
+        else:
+            from ..core.overlap import decode_breaking_points_batch
+            w, metas = bp_meta
+            arrs = decode_breaking_points_batch(
+                fb, [metas[i][1] for i in reject],
+                [metas[i][0] for i in reject],
+                [metas[i][0] + len(pairs[i][1]) for i in reject], w)
+            for i, arr in zip(reject, arrs):
+                results[i] = arr
 
     def _launch_chunk(self, pairs, chunk, max_len, band, bp_meta=None):
         """Span-wrapped :meth:`_launch_chunk_impl` — the dispatch half
         of the aligner's dispatch-vs-fetch split (host pack + async
         kernel dispatch; the device computes after this returns)."""
+        faults.check("align.dispatch")
         with self._pinned(), obs.span("align.dispatch", pairs=len(chunk),
                                       max_len=max_len, band=band):
             return self._launch_chunk_impl(pairs, chunk, max_len, band,
@@ -722,6 +1018,24 @@ class TpuAligner(PallasDispatchMixin):
             n[k], m[k] = len(qb), len(tb)
 
         steps = _sweep_bound(int((n + m).max()), max_len)
+
+        # occupancy telemetry (round 17): the launch's wavefront arena
+        # is B x steps band-wide DP rows; each real pair only produces
+        # work on its own n+m anti-diagonals — the rest (batch pow2
+        # padding + dead wavefronts past each pair's finish) is the
+        # waste the ragged packer and band ladder exist to cut
+        occ = int(n[:len(chunk)].sum()) + int(m[:len(chunk)].sum())
+        total = B * steps
+        self.stats["chunks"] += 1
+        self.stats["lanes_occupied"] += occ
+        self.stats["lanes_total"] += total
+        self.stats["steps_wasted"] += total - occ
+        self.stats["wavefront_work"] += total * band
+        metrics.inc("align.chunks")
+        metrics.inc("align.lanes_occupied", occ)
+        metrics.inc("align.lanes_total", total)
+        metrics.inc("align.steps_wasted", total - occ)
+        metrics.inc("align.wavefront_work", total * band)
 
         # host->device bytes are the bottleneck on thin links: when the
         # chunk's alphabet fits 4 symbols (ACGT does) and the SWAR path
@@ -907,6 +1221,8 @@ class TpuAligner(PallasDispatchMixin):
         ops = ((ops_packed[:, :, None] >> shifts) & 3).reshape(
             ops_packed.shape[0], -1)
 
+        obs_scores: List[int] = []
+        obs_maxlens: List[int] = []
         for k, idx in enumerate(chunk):
             diff = abs(int(n[k]) - int(m[k]))
             # real path codes are < 3 (a band escape stalls the walk,
@@ -915,6 +1231,14 @@ class TpuAligner(PallasDispatchMixin):
             # handles both
             path = ops[k][ops[k] < 3]
             clean = (len(path) > 0 and int(fi[k]) == 0 and int(fj[k]) == 0)
+            # adaptive-ladder signal: any CLEAN walk's finite score —
+            # accepted (the true distance) or gate-failed (the banded
+            # distance, an upper bound, i.e. a conservative estimate) —
+            # a run whose first chunks all escape still teaches the
+            # estimator to stop seeding low
+            if clean and int(score[k]) < (1 << 28):
+                obs_scores.append(int(score[k]))
+                obs_maxlens.append(max(int(n[k]), int(m[k])))
             # optimality certificate: an optimal path's diagonal wander is
             # bounded by its edit count; require it inside the half band.
             if int(score[k]) <= band // 2 - diff - 2 and clean:
@@ -922,6 +1246,8 @@ class TpuAligner(PallasDispatchMixin):
                 self.stats["device"] += 1
             else:
                 reject.append(idx)
+        if obs_scores:
+            self._observe_divergence(obs_scores, obs_maxlens)
 
     def _refetch_xla(self, launched, band, bp_meta, exc):
         """A Pallas *runtime* fault surfaced at the async fetch (the
@@ -954,9 +1280,16 @@ class TpuAligner(PallasDispatchMixin):
         n_h = np.asarray(n[:C], dtype=np.int64)
         m_h = np.asarray(m[:C], dtype=np.int64)
         diff = np.abs(n_h - m_h)
-        accept = ((np.asarray(score[:C], dtype=np.int64)
-                   <= band // 2 - diff - 2)
-                  & (np.asarray(fi[:C]) == 0) & (np.asarray(fj[:C]) == 0))
+        clean = (np.asarray(fi[:C]) == 0) & (np.asarray(fj[:C]) == 0)
+        score_h = np.asarray(score[:C], dtype=np.int64)
+        accept = (score_h <= band // 2 - diff - 2) & clean
+        # adaptive-ladder signal: every clean walk's finite score (see
+        # the CIGAR path) — gate-failed ones are banded upper bounds,
+        # so the estimate errs wide, never low
+        obs = clean & (score_h < (1 << 28))
+        if obs.any():
+            self._observe_divergence(score_h[obs],
+                                     np.maximum(n_h, m_h)[obs])
         tb = np.fromiter((metas[idx][0] for idx in chunk), np.int64, C)
         qo = np.fromiter((metas[idx][1] for idx in chunk), np.int64, C)
         te = tb + np.fromiter((len(pairs[idx][1]) for idx in chunk),
@@ -979,3 +1312,369 @@ class TpuAligner(PallasDispatchMixin):
                 self.stats["device"] += 1
             else:
                 reject.append(idx)
+
+    # ------------------------------------------------------------- warm-up
+
+    @staticmethod
+    def _pow2_at_least(x: int) -> int:
+        p = 1
+        while p < max(1, x):
+            p *= 2
+        return p
+
+    def _warmup_shapes(self, est_len: int, est_pairs: int,
+                       window_length: int):
+        """The ``(max_len, band, steps, B, window_length)`` chunk shapes
+        the align stream is expected to dispatch for pairs of roughly
+        ``est_len`` bases — the ladder seed rung for a typical
+        low-divergence overlap plus the bucket-band escape rung — ONE
+        source of truth consumed by :meth:`warmup_async`, derived with
+        the same geometry/cap rules the stream uses."""
+        g = self._seed_geometry(est_len, est_len, 0.05, record=False)
+        if g is None:
+            return []
+        bi, band = g
+        max_len, bucket_band = self.buckets[bi]
+        bands = [band]
+        if bucket_band not in bands:
+            bands.append(bucket_band)
+        shapes = []
+        for bd in bands:
+            steps = _sweep_bound(2 * est_len, max_len)
+            cap = self._chunk_cap(steps, bd)
+            B = self._pow2_at_least(min(cap, est_pairs))
+            shapes.append((max_len, bd, steps, B, window_length))
+        return shapes
+
+    def warmup_async(self, est_len: int, est_pairs: int,
+                     window_length: int = 500):
+        """Background warm-up compilation of the expected align-chunk
+        shapes (the aligner analog of ``TpuPoaConsensus.warmup_async``):
+        the resident polishing service calls this at startup and per
+        admitted job so job #1's alignment phase dispatches into a hot
+        jit cache. Derives the ragged stream's chunk geometry
+        (:meth:`_warmup_shapes`) and executes the full kernel chain —
+        row build, wavefront DP, packed walk, breaking-points tables —
+        once per shape on near-empty inputs (real lengths of 1, so the
+        Pallas dynamic sweep bound makes the execution itself cheap;
+        the compile is the product). Shape-deduped like the consensus
+        warm-up, so repeat geometries are free; a wrong estimate wastes
+        a background compile and nothing else. Returns the thread (for
+        tests) or None when skipped (mesh runs, zero estimates, every
+        shape already warmed)."""
+        if self.mesh is not None or est_pairs <= 0 or est_len <= 0:
+            return None
+        shapes = [s for s in self._warmup_shapes(est_len, est_pairs,
+                                                 window_length)
+                  if s not in self._warmed_shapes]
+        if not shapes:
+            return None
+        self._warmed_shapes.update(shapes)
+
+        def _compile_one(max_len, band, steps, B, w):
+            # the availability probes compile and run kernels, so they
+            # belong on this thread too (same choice order as
+            # _launch_chunk_impl: ACGT chunks take the 2-bit path);
+            # probed directly rather than via _swar_choice so the warm
+            # thread never writes the stats dict the main thread owns
+            from .swar import swar_fits, swar_ok
+            sw = self.use_swar and swar_fits(max_len) and swar_ok()
+            n = jnp.ones((B,), jnp.int32)
+            m = jnp.ones((B,), jnp.int32)
+            if sw:
+                from .swar import pack_bases_2bit
+                blk = jnp.asarray(pack_bases_2bit(
+                    np.zeros(B * max_len, np.uint8)))
+                qrp, tp = _build_rows_packed2(blk, blk, n, m,
+                                              max_len=max_len, band=band)
+            else:
+                z = jnp.zeros((B * max_len,), jnp.uint8)
+                qrp, tp = _build_rows(z, z, n, m, max_len=max_len,
+                                      band=band)
+            base_key = (max_len, band, steps, B)
+            use_pallas = self._use_pallas(base_key)
+            if use_pallas and sw:
+                from .pallas_nw import pallas_swar_ok
+                sw = (sw and pallas_swar_ok()
+                      and self._use_pallas(base_key + ("swar",)))
+            out = align_chain(qrp, tp, n, m, max_len=max_len, band=band,
+                              steps=steps, use_pallas=use_pallas,
+                              use_swar=sw)
+            if w:
+                NW = max_len // max(w, 1) + 2
+                _breaking_points_kernel(
+                    out[0], n, m, jnp.zeros((B,), jnp.int32),
+                    jnp.ones((B,), jnp.int32), w=w, NW=NW)
+            jax.block_until_ready(out[1])
+
+        def _run():
+            with self._pinned():
+                for shape in shapes:
+                    try:
+                        _compile_one(*shape)
+                    except Exception as e:
+                        from ..utils.logger import log_swallowed
+                        log_swallowed(
+                            f"aligner warm-up shape {shape} failed "
+                            f"(run()'s own shapes still compile on "
+                            f"first use)", e)
+
+        import threading
+
+        # fire-and-forget by design: a daemon thread killed at exit
+        # loses nothing but a speculative compile (same contract as the
+        # consensus warm-up thread)
+        # graftlint: disable=thread-lifecycle (droppable best-effort warm-up; daemon dies harmlessly at exit)
+        th = threading.Thread(target=_run, daemon=True,
+                              name="racon-align-warmup")
+        th.start()
+        return th
+
+
+class _AlignStream:
+    """Ragged streaming align session (round 17) — the aligner analog of
+    ``poa._ConsensusStream``.
+
+    Pairs arrive through :meth:`feed` in any number of slices; each is
+    seeded a ``(bucket, band)`` geometry class (the band ladder's rung
+    when an overlap-error estimate admits one) and classes greedy-fill
+    device chunks against the engine's fixed direction-matrix arena
+    budget **by each pair's actual sweep cost**: within a class, pairs
+    sort longest-first and every chunk's pair cap is re-derived from its
+    OWN head's sweep bound — short tail chunks both shrink their
+    compiled step count and grow their batch, instead of every chunk
+    paying one cap sized for the bucket's longest pair (the cudabatch
+    batch-fill shape, ``cudabatch.cpp:54-62``; ``reduce_capacity``
+    halves the arena under OOM backpressure).
+
+    Full chunks dispatch ASYNCHRONOUSLY the moment they close: host
+    packing of the next slice overlaps device compute of the previous
+    chunks, and fetches happen only when the in-flight byte budget
+    forces one or at :meth:`finish` — the double-buffered dispatch that
+    keeps the per-chunk tunnel round-trip (which bounds real runs, see
+    the module constants) off the critical path. Band escapes re-enter
+    the pending classes at their escalated rung and re-dispatch
+    *batched*; geometry strictly escalates, so the drain loop
+    terminates. Accepted alignments are byte-identical at every rung
+    (the ``score <= band/2 - diff - 2`` accept gate is an optimality
+    certificate: any cell whose value can influence a traceback
+    decision is provably uninflated by the banding), and the terminal
+    geometry sequence is the fixed path's, so the host-fallback reject
+    set matches too — the {bucketed, ragged} x {fixed-band, ladder}
+    byte-identity contract ``tests/test_align_stream.py`` locks.
+
+    Resolved pairs release their span bytes immediately; the resident
+    set is bounded by the in-flight pipeline plus one partial chunk per
+    geometry class (``MAX_CHUNK_PAIRS`` bounds each), preserving the
+    polisher's O(slice) transient-copy contract."""
+
+    def __init__(self, eng: "TpuAligner", window_length=None,
+                 progress=None, total_hint: int = 0):
+        self.eng = eng
+        self.w = window_length             # None -> CIGAR mode
+        self.progress = progress
+        self.total_hint = total_hint
+        self.results: List = []            # per fed pair, feed order
+        self.pairs: dict = {}              # slot -> (q, t), until resolved
+        self.metas: dict = {}              # slot -> (t_begin, q_off)
+        self.buffer: List = []             # (slot, err) awaiting a seed
+        self.pending: dict = {}            # (bucket, band) -> [slot]
+        self.reject: List[int] = []        # host-fallback slots
+        self.inflight: List[dict] = []
+        self.inflight_bytes = 0
+        self.inflight_pairs = 0
+        self.done_pairs = 0
+        self._done = False
+        self._est_warmed = False  # first-chunk eager fetch fired
+        self._empty_bp = np.zeros((0, 4), dtype=np.int32)
+
+    def _bp_meta(self):
+        return None if self.w is None else (self.w, self.metas)
+
+    def _tick(self) -> None:
+        if self.progress is not None:
+            self.progress(self.done_pairs,
+                          max(self.total_hint, len(self.results)))
+
+    # ------------------------------------------------------------- intake
+
+    def feed(self, pairs, metas=None, errors=None) -> None:
+        """Add a pair slice; packs and dispatches every chunk that
+        fills. Returns without blocking unless the in-flight byte
+        budget forces a (pipelined) fetch."""
+        assert not self._done, "align stream already finished"
+        for k, (q, t) in enumerate(pairs):
+            slot = len(self.results)
+            if len(q) == 0 or len(t) == 0:
+                # resolved inline: no span, no meta retained
+                if self.w is None:
+                    self.results.append(f"{len(t)}D" if len(t) else
+                                        (f"{len(q)}I" if len(q) else ""))
+                else:
+                    self.results.append(self._empty_bp)
+                self.done_pairs += 1
+                continue
+            if self.w is not None:
+                self.metas[slot] = metas[k]
+            self.results.append("" if self.w is None else self._empty_bp)
+            self.pairs[slot] = (q, t)
+            # seeds are assigned at FLUSH time, not here: with the
+            # ladder on, pairs buffered behind the cold-start probe are
+            # seeded from OBSERVED divergence instead of the blind
+            # span-asymmetry proxy
+            self.buffer.append((slot,
+                                None if errors is None else errors[k]))
+        self._flush(final=False)
+        self._tick()
+
+    # ----------------------------------------------------------- dispatch
+
+    def _classify(self, buffered) -> None:
+        """Seed buffered pairs into (bucket, band) geometry classes
+        with the estimator's CURRENT knowledge."""
+        eng = self.eng
+        for slot, err in buffered:
+            q, t = self.pairs[slot]
+            g = eng._seed_geometry(len(q), len(t), err)
+            if g is None:
+                eng.stats["fallback_length"] += 1
+                self.reject.append(slot)
+            else:
+                self.pending.setdefault(g, []).append(slot)
+
+    def _flush(self, final: bool) -> None:
+        eng = self.eng
+        # cold-start ladder probe: seed + force-dispatch + fetch a
+        # small leading batch first (the eager fetch in _launch), so
+        # every LATER seed uses observed divergence — without it, a
+        # substitution-heavy run whose span-asymmetry estimates read
+        # near zero would seed every chunk low and escape them all
+        if (eng.use_ladder and self.buffer and not self._est_warmed
+                and eng._adaptive_divergence() is None):
+            if not final and len(self.buffer) < ALIGN_PROBE_PAIRS:
+                return                     # wait for a probe's worth
+            probe = self.buffer[:ALIGN_PROBE_PAIRS]
+            self.buffer = self.buffer[ALIGN_PROBE_PAIRS:]
+            self._classify(probe)
+            self._drain(final=True)        # partial probe chunks too
+        if self.buffer:
+            self._classify(self.buffer)
+            self.buffer = []
+        self._drain(final)
+
+    def _drain(self, final: bool) -> None:
+        eng = self.eng
+        for cls in sorted(self.pending):
+            # drain a DETACHED list: _launch below may force a fetch
+            # (_finish_oldest) whose escapees escalate into this very
+            # class — they must land in a fresh pending entry, not be
+            # appended behind the one-time longest-first sort (the head
+            # invariant sizes the chunk cap and the in-flight bytes)
+            slots = self.pending.pop(cls)
+            bi, band = cls
+            max_len = eng.buckets[bi][0]
+            # longest first: a chunk's compiled sweep bound tracks its
+            # OWN head, so similar-length pairs share chunks and short
+            # tail chunks shrink their steps AND grow their batch
+            slots.sort(key=lambda s: -(len(self.pairs[s][0])
+                                       + len(self.pairs[s][1])))
+            while slots:
+                q0, t0 = self.pairs[slots[0]]
+                steps = _sweep_bound(len(q0) + len(t0), max_len)
+                cap = eng._chunk_cap(steps, band)
+                if not final and len(slots) < cap:
+                    break                  # wait for more pairs
+                chunk = slots[:cap]
+                del slots[:cap]
+                self._launch(cls, chunk, max_len, band)
+            if slots:
+                # re-merge the unfilled remainder with any escapees
+                # that arrived mid-drain (order is irrelevant — the
+                # next drain re-sorts)
+                self.pending.setdefault(cls, []).extend(slots)
+
+    def _launch(self, cls, chunk, max_len: int, band: int) -> None:
+        eng = self.eng
+        launched = eng._launch_chunk(self.pairs, chunk, max_len, band,
+                                     self._bp_meta())
+        q0, t0 = self.pairs[chunk[0]]     # head = chunk's longest pair
+        steps = _sweep_bound(len(q0) + len(t0), max_len)
+        entry = {"cls": cls, "chunk": chunk, "launched": launched,
+                 "bytes": eng._pad_batch(len(chunk)) * steps * (band // 8)}
+        self.inflight.append(entry)
+        self.inflight_bytes += entry["bytes"]
+        self.inflight_pairs += len(chunk)
+        # cold-estimator eager fetch: with the ladder on, the FIRST
+        # chunk fetches immediately so the adaptive divergence
+        # estimator learns real scores before the pipeline fills —
+        # otherwise a substitution-heavy run (whose span-asymmetry
+        # estimates read near zero) seeds EVERY chunk low and escapes
+        # them all; one pipeline bubble at run start buys the whole
+        # run's seeds
+        if (eng.use_ladder and not self._est_warmed
+                and eng._adaptive_divergence() is None):
+            self._finish_oldest()
+        self._est_warmed = True
+        # the pair bound keeps unresolved host span copies O(slice)
+        # even when the chunks are byte-cheap (short pairs at narrow
+        # rungs) — each unresolved pair pins its q/t byte copies
+        while (len(self.inflight) > max(eng.num_batches, 1)
+               and (self.inflight_bytes > eng.dirs_budget_cap
+                    or self.inflight_pairs > MAX_INFLIGHT_PAIRS)):
+            self._finish_oldest()
+
+    def _finish_oldest(self) -> None:
+        eng = self.eng
+        la = self.inflight.pop(0)
+        self.inflight_bytes -= la["bytes"]
+        self.inflight_pairs -= len(la["chunk"])
+        esc: List[int] = []
+        eng._finish_chunk(la["launched"], la["cls"][1], self.results,
+                          esc, self._bp_meta())
+        esc_set = set(esc)
+        for slot in la["chunk"]:
+            if slot not in esc_set:
+                # resolved: release the span bytes AND the meta tuple —
+                # a whole-run session must not retain O(total) of either
+                self.pairs.pop(slot, None)
+                self.metas.pop(slot, None)
+                self.done_pairs += 1
+        bi, band = la["cls"]
+        for slot in esc:
+            q, t = self.pairs[slot]
+            ng = eng._next_geometry(len(q), len(t), bi, band)
+            if ng is None:
+                eng.stats["fallback_band"] += 1
+                metrics.inc("aligner.fallback_band")
+                self.reject.append(slot)
+            else:
+                eng.stats["band_escalated"] += 1
+                metrics.inc("aligner.band_escalated")
+                self.pending.setdefault(ng, []).append(slot)
+        self._tick()
+
+    # -------------------------------------------------------------- drain
+
+    def finish(self) -> List:
+        """Dispatch the partial chunks, drain the pipeline (escapees
+        re-dispatch batched at their wider rungs until none remain),
+        run the host fallback; results for every fed pair in feed
+        order."""
+        assert not self._done, "align stream already finished"
+        self._done = True
+        eng = self.eng
+        self._flush(final=True)
+        while self.inflight or self.pending:
+            while self.inflight:
+                self._finish_oldest()
+            self._flush(final=True)
+        self.done_pairs += len(self.reject)
+        eng._resolve_rejects(self.pairs, self.reject, self.results,
+                             self._bp_meta())
+        for slot in self.reject:
+            self.pairs.pop(slot, None)
+            self.metas.pop(slot, None)
+        if self.progress is not None:
+            total = max(self.total_hint, len(self.results))
+            self.progress(total, total)
+        return self.results

@@ -155,8 +155,14 @@ def swar_ok() -> bool:
                                           band=band, swar=True)
             dx, sx = _nw_wavefront_kernel(*args, max_len=max_len,
                                           band=band)
+            # packed walk (round 17): the SWAR path's traceback carries
+            # (i, j) as one halfword pair — probe it against the
+            # unpacked walk on the same matrices, so a backend whose
+            # shift/mask lowering misbehaves downgrades the whole
+            # packed path (fwd + walk) together
+            # graftlint: disable=swar-guard (probe bucket: 256 + 2 < BIG16 by construction)
             op_, fip, fjp = _walk_ops_kernel(dp, args[2], args[3],
-                                             band=band)
+                                             band=band, swar=True)
             ox, fix, fjx = _walk_ops_kernel(dx, args[2], args[3],
                                             band=band)
             _SWAR_OK = (

@@ -370,10 +370,16 @@ class PolishServer:
         for w in self._chip_slots():
             aligner, consensus = w.get_engines(cpu=False)
             warm = getattr(consensus, "warmup_async", None)
-            if warm is None:
-                continue
+            awarm = getattr(aligner, "warmup_async", None)
             for (wl, pairs, wins, contigs) in shapes:
-                warm(wl, pairs, wins, est_contigs=contigs)
+                if warm is not None:
+                    warm(wl, pairs, wins, est_contigs=contigs)
+                if awarm is not None:
+                    # align-chunk geometry (round 17): overlap spans run
+                    # read-length scale, not window scale — ~8 windows
+                    # per ONT-era read is the profile's implied ratio;
+                    # a wrong estimate only wastes a background compile
+                    awarm(8 * wl, max(1, pairs // 8), window_length=wl)
         _eprint(f"engine pool: {len(self._chip_slots())} worker(s), "
                 f"budget {self.budget_bytes >> 20} MB, "
                 f"{len(shapes)} warm shape profile(s)")
@@ -396,6 +402,11 @@ class PolishServer:
             if warm is not None:
                 warm(wl, est_pairs, est_windows,
                      est_contigs=max(1, min(est_windows, 8)))
+            awarm = getattr(w.engines[0], "warmup_async", None)
+            if awarm is not None:
+                # align-stream geometry (round 17): see _warm_pool —
+                # shape-deduped in the engine, so repeats are free
+                awarm(8 * wl, max(1, est_pairs // 8), window_length=wl)
 
     # --------------------------------------------------------- admission
 

@@ -78,14 +78,23 @@ def test_metrics_thread_safety():
 
 def test_pack_summary_derivation():
     metrics.clear("consensus.")
+    metrics.clear("align.")
     assert metrics.pack_summary()["groups"] == 0
     metrics.inc("consensus.lanes_occupied", 600)
     metrics.inc("consensus.lanes_total", 1000)
     metrics.inc("consensus.groups", 2)
     metrics.inc("consensus.group_windows", 10)
+    # the round-17 aligner half of the summary
+    metrics.inc("align.lanes_occupied", 300)
+    metrics.inc("align.lanes_total", 400)
+    metrics.inc("align.chunks", 3)
+    metrics.inc("align.steps_wasted", 100)
     pack = metrics.pack_summary()
     assert pack == {"pack_efficiency": 0.6, "pad_fraction": 0.4,
-                    "windows_per_group": 5.0, "groups": 2}
+                    "windows_per_group": 5.0, "groups": 2,
+                    "align_pack_efficiency": 0.75,
+                    "align_pad_fraction": 0.25,
+                    "align_chunks": 3, "align_steps_wasted": 100}
 
 
 # ------------------------------------------------------------ span tracer
