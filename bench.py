@@ -888,6 +888,7 @@ def bench_service():
                     raise RuntimeError("resident server did not start")
                 time.sleep(0.2)
             lat, frac = [], []
+            compiles_after_warm = 0
             spec = {"sequences": reads, "overlaps": paf,
                     "target_sequences": draft, "threads": 4}
             for k in range(n_jobs):
@@ -904,10 +905,19 @@ def bench_service():
                 lat.append(wall)
                 frac.append(header.get("compile_s", 0.0)
                             / max(header.get("wall_s", wall), 1e-9))
+                if k >= 1:
+                    # the server seals its warm path when job #1
+                    # completes: from job #2 on, the attributed
+                    # post-warm compile count must be exactly zero —
+                    # the warm-path claim, now measured, not inferred
+                    compiles_after_warm += int(
+                        header.get("compiles_after_warm", 0))
                 if k in (0, 1) or (k + 1) % 20 == 0:
                     log(f"service bench: job {k + 1}/{n_jobs} "
                         f"{wall:.2f}s (compile "
-                        f"{header.get('compile_s', 0.0):.2f}s)")
+                        f"{header.get('compile_s', 0.0):.2f}s, "
+                        f"post-warm compiles "
+                        f"{header.get('compiles_after_warm', 0)})")
             with ServiceClient(sock, timeout_s=60) as c:
                 c.shutdown()
             server.wait(timeout=120)
@@ -926,12 +936,19 @@ def bench_service():
         assert compile_fraction < 0.1, (
             f"warm jobs are still compile-dominated "
             f"(service_compile_fraction={compile_fraction:.3f})")
+        assert compiles_after_warm == 0, (
+            f"{compiles_after_warm} XLA compile(s) attributed to "
+            f"repeat-shape jobs after the warm-path seal — the "
+            f"server's warm-path claim is broken (see the "
+            f"compiles_after_warm headers / the job reports' "
+            f"`compiles` section for the offending signatures)")
         out.update(
             service_mbp=mbp, service_jobs=n_jobs,
             service_p50_s=round(p50, 3),
             service_p95_s=round(p95, 3),
             service_first_job_s=round(lat[0], 3),
             service_compile_fraction=round(compile_fraction, 4),
+            service_compiles_after_warm=compiles_after_warm,
             service_cold_oneshot_s=round(cold_s, 2),
             service_speedup_vs_cold=round(cold_s / p50, 2),
             service_identity="byte-identical")

@@ -7,20 +7,22 @@
 set -e
 cd "$(dirname "$0")/../.."
 # ONE consolidated graftlint gate (fail-fast, cheapest): the linter's
-# fixture-based self-tests, then a single repo-wide run with all 11
+# fixture-based self-tests, then a single repo-wide run with all 16
 # rules — tracer leaks, unguarded SWAR entry points, swallowed
-# exceptions, rogue env flags, host syncs, span discipline, and the
+# exceptions, rogue env flags, host syncs, span discipline, the
 # round-15 concurrency/durability pack (lock-discipline,
 # blocking-under-lock, atomic-write-discipline, thread-lifecycle,
-# scope-discipline). Zero unsuppressed findings is a hard gate; this
-# replaces the five former per-shard `tools.analysis <subdir>` runs —
-# the project indexes (call graph, contexts, blocking closure) build
-# once instead of six times. Wall time is recorded so the gate's cost
-# stays visible (budget: < 30 s on this repo).
+# scope-discipline) and the round-18 compile-surface pack
+# (jit-shape-hazard, dtype-drift, jit-in-loop, warmup-coverage,
+# host-transfer-in-jit). Zero unsuppressed findings is a hard gate;
+# the machine-readable findings land in a CI artifact file so rule
+# regressions are diffable across runs. Wall time is recorded so the
+# gate's cost stays visible (budget: < 30 s on this repo).
 lint_t0=$SECONDS
 python -m tools.analysis --selftest
-python -m tools.analysis --quiet racon_tpu tests tools bench.py
-echo "graftlint gate (selftest + repo-wide, 11 rules): $((SECONDS - lint_t0))s (budget 30s)"
+python -m tools.analysis --quiet --json /tmp/graftlint_findings.json \
+  racon_tpu tests tools bench.py
+echo "graftlint gate (selftest + repo-wide, 16 rules): $((SECONDS - lint_t0))s (budget 30s; artifact /tmp/graftlint_findings.json)"
 # the README env-flags table is generated from racon_tpu/flags.py and
 # must not drift
 python -m racon_tpu.flags --check-readme README.md
@@ -101,13 +103,25 @@ python -m pytest tests/test_serve_recovery.py -q
 # RACON_TPU_TRACE byte-identity, disabled-span overhead guard,
 # run-report schema validation for CLI and exec runs
 python -m pytest tests/test_obs.py -q
-python -m pytest tests/ -x -q --ignore=tests/test_ops_swar.py \
+# compile-surface runtime shard (fail-fast, round 18): forced-retrace
+# attribution names the compiling (function, shape signature, phase),
+# the absorbed serve compile_s listener's scoped semantics, the
+# schema-v7 `compiles` section and the seal/violation bookkeeping
+# (the sanitized serve warm-path acceptance test itself rides at the
+# end of the resident-service shard — it must trace AFTER that
+# shard's cold-retrace asserts)
+python -m pytest tests/test_compile_surface.py -q
+# catch-all (every file without a dedicated shard above) runs with the
+# tier-1 slow filter: @pytest.mark.slow tests only execute in the
+# per-file shards that name them, never silently in the budget run
+python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_columnar_init.py --ignore=tests/test_window.py \
   --ignore=tests/test_exec.py --ignore=tests/test_ragged.py \
   --ignore=tests/test_align_stream.py \
   --ignore=tests/test_obs.py --ignore=tests/test_faults.py \
   --ignore=tests/test_serve.py --ignore=tests/test_serve_recovery.py \
-  --ignore=tests/test_topology.py --ignore=tests/test_parallel.py
+  --ignore=tests/test_topology.py --ignore=tests/test_parallel.py \
+  --ignore=tests/test_compile_surface.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager, nullcontext
 
-from . import metrics, report, trace
+from . import compilewatch, metrics, report, trace
 from .trace import span, track  # noqa: F401  (the public span surface)
 
 
@@ -34,6 +34,12 @@ def begin(trace_path=None, report_path=None) -> None:
     recording: timers whenever either output was requested, ring
     buffers only when a trace file was."""
     metrics.clear_run()
+    # compile attribution resets with the run metrics it rides next to
+    # (clear_run drops the compile.* timers/counters) — a second run in
+    # the same process must not report the first run's events.  Called
+    # once per CLI/exec run; the resident server jobs never pass
+    # through here, so the serve warm-path seal is untouched.
+    compilewatch.reset()
     if trace_path or report_path:
         trace.activate(tracing=bool(trace_path))
 

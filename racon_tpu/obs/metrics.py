@@ -154,7 +154,7 @@ def clear(prefix: Optional[str] = None) -> None:
 _RUN_PREFIXES = ("align.", "poa.", "consensus.", "queue.", "retrace.",
                  "retrace_total.", "swallowed.", "trace.", "parse.",
                  "overlap.", "transmute", "bp.", "build.", "stitch",
-                 "exec.", "faults.", "lease.", "device.")
+                 "exec.", "faults.", "lease.", "device.", "compile.")
 
 
 def clear_run() -> None:

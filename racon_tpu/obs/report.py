@@ -23,7 +23,7 @@ import os
 import sys
 from typing import Dict, List, Optional
 
-from . import metrics
+from . import compilewatch, metrics
 
 # v2 (round 12): the "faults" section (fault-class / injected-site /
 # lease-event counts) became required and shard rows grew the
@@ -49,7 +49,16 @@ from . import metrics
 # aligner's efficiency signal), and "dispatch_fetch"'s align split now
 # also lands in Polisher.timings (align_dispatch_s / align_fetch_s in
 # the phases dict).
-SCHEMA_VERSION = 6
+# v7 (round 18): the "compiles" section became required — process-wide
+# XLA compile attribution from the one jax.monitoring listener
+# (racon_tpu.obs.compilewatch): total attributed seconds, backend-
+# compile count, warm-path violations after the serve seal
+# ("post_warm", asserted 0 from job #2 on in bench_service), whether
+# the warm path is sealed, per-function rollups ("by_function") and
+# the trailing attributed events, each carrying (function, shape
+# signature, phase, duration).  Per-job reports filter all of it to
+# the job's scope.
+SCHEMA_VERSION = 7
 
 KINDS = ("cli", "exec", "job")
 
@@ -70,6 +79,7 @@ _TOP = {
     "swallowed": (dict, True),          # fault key -> occurrence count
     "faults": (dict, True),             # fault class/site/lease counts
     "recovery": (dict, True),           # crash-safe serving counters
+    "compiles": (dict, True),           # XLA compile attribution (v7)
     "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
@@ -85,6 +95,8 @@ _RECOVERY_KEYS = ("recovered_jobs", "requeued_jobs",
                   "journal_replayed", "journal_records",
                   "journal_compactions", "slot_restarts",
                   "slot_quarantined")
+_COMPILES_NUM_KEYS = ("total_s", "count", "post_warm", "sealed")
+_COMPILE_EVENT_STR_KEYS = ("fn", "signature", "phase")
 
 # per-shard row schema: key -> (accepted types, required)
 _SHARD_ROW = {
@@ -169,6 +181,11 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         # supervision counters — server-level, so every kind embeds
         # the hosting process's totals (zeros outside serve mode)
         "recovery": metrics.recovery_summary(),
+        # XLA compile attribution (round 18, schema v7): per-function
+        # counts/seconds and the attributed (function, signature,
+        # phase) events from the process-wide jax.monitoring listener;
+        # "post_warm" counts compiles after the serve warm-path seal
+        "compiles": compilewatch.summary(scope),
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and
         # the span-timer mirrors (dispatch/fetch per chip). {} on
@@ -242,6 +259,32 @@ def validate_report(rep) -> List[str]:
     for key in _PACK_KEYS:
         if not isinstance(rep["pack"].get(key), _NUM):
             errors.append(f"pack[{key!r}] missing or non-numeric")
+    comp = rep["compiles"]
+    for key in _COMPILES_NUM_KEYS:
+        if not isinstance(comp.get(key), _NUM) \
+                or isinstance(comp.get(key), bool):
+            errors.append(f"compiles[{key!r}] missing or non-numeric")
+    if not isinstance(comp.get("by_function"), dict):
+        errors.append("compiles['by_function'] missing or not an object")
+    else:
+        for fn, row in comp["by_function"].items():
+            if not isinstance(row, dict):
+                errors.append(f"compiles.by_function[{fn!r}] is not an "
+                              f"object row")
+            else:
+                _check_numeric_dict(errors, row,
+                                    f"compiles.by_function[{fn!r}]")
+    if not isinstance(comp.get("events"), list):
+        errors.append("compiles['events'] missing or not a list")
+    else:
+        for i, ev in enumerate(comp["events"]):
+            if not isinstance(ev, dict) or not all(
+                    isinstance(ev.get(k), str)
+                    for k in _COMPILE_EVENT_STR_KEYS) \
+                    or not isinstance(ev.get("duration_s"), _NUM):
+                errors.append(f"compiles.events[{i}] is not an "
+                              f"attributed record (fn/signature/phase/"
+                              f"duration_s)")
     for kind in ("counters", "gauges", "timers"):
         store = rep["metrics"].get(kind)
         if not isinstance(store, dict):

@@ -116,11 +116,21 @@ class _Span:
         self.args = args
 
     def __enter__(self):
+        # the open-span stack feeds phase attribution (the compile
+        # watch reads the innermost open span when XLA compiles on
+        # this thread) — a TLS list append, active-mode only
+        st = getattr(_tls, "span_stack", None)
+        if st is None:
+            st = _tls.span_stack = []
+        st.append(self.name)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter()
+        st = getattr(_tls, "span_stack", None)
+        if st:
+            st.pop()
         metrics.add_time(self.name, t1 - self._t0)
         # per-thread timer prefix (set_timer_prefix): the chip-worker
         # threads mirror their spans under device.<ordinal>.* so the
@@ -172,6 +182,14 @@ def track(name: str):
     if not _tracing:
         return NULL_SPAN
     return _Track(name)
+
+
+def current_span():
+    """The CURRENT THREAD's innermost open span name (None when no
+    span is open or recording is off) — the compile watch stamps it as
+    the phase of every XLA compile attributed to this thread."""
+    st = getattr(_tls, "span_stack", None)
+    return st[-1] if st else None
 
 
 def get_timer_prefix():

@@ -56,3 +56,13 @@ def configure_compile_cache(cache_dir: _Optional[str] = None,
 # RACON_TPU_NO_COMPILE_CACHE=1.
 if not _flags.get_bool("RACON_TPU_NO_COMPILE_CACHE"):
     configure_compile_cache()
+
+# Process-wide compile attribution (round 18): every XLA compile lands
+# in the obs registry (the scoped ``compile.jax_s`` timer + per-function
+# ``compile.<fn>`` counters) and the compile-watch event ring,
+# attributed to (function, shape signature, phase, scope).  Armed here
+# because importing ops precedes every kernel compile; idempotent, and
+# a no-op without jax.
+from ..obs import compilewatch as _compilewatch  # noqa: E402
+
+_compilewatch.arm()

@@ -944,6 +944,7 @@ class TpuAligner(PallasDispatchMixin):
                 bi, band = cls
                 for idx in idxs:
                     q, t = pairs[idx]
+                    # graftlint: disable=warmup-coverage (escalation rungs are data-dependent and rare by design; the terminal rung — the bucket band — IS warmed as the escape shape)
                     ng = self._next_geometry(len(q), len(t), bi, band)
                     if ng is None:
                         self.stats["fallback_band"] += 1
@@ -1315,13 +1316,6 @@ class TpuAligner(PallasDispatchMixin):
 
     # ------------------------------------------------------------- warm-up
 
-    @staticmethod
-    def _pow2_at_least(x: int) -> int:
-        p = 1
-        while p < max(1, x):
-            p *= 2
-        return p
-
     def _warmup_shapes(self, est_len: int, est_pairs: int,
                        window_length: int):
         """The ``(max_len, band, steps, B, window_length)`` chunk shapes
@@ -1342,7 +1336,10 @@ class TpuAligner(PallasDispatchMixin):
         for bd in bands:
             steps = _sweep_bound(2 * est_len, max_len)
             cap = self._chunk_cap(steps, bd)
-            B = self._pow2_at_least(min(cap, est_pairs))
+            # the launcher's own batch-padding rule (plain pow2 here:
+            # warm-up never runs under a mesh) — warmup-coverage keeps
+            # this shared with _launch_chunk_impl
+            B = self._pad_batch(min(cap, est_pairs))
             shapes.append((max_len, bd, steps, B, window_length))
         return shapes
 
@@ -1642,6 +1639,7 @@ class _AlignStream:
         bi, band = la["cls"]
         for slot in esc:
             q, t = self.pairs[slot]
+            # graftlint: disable=warmup-coverage (escalation rungs are data-dependent and rare by design; the terminal rung — the bucket band — IS warmed as the escape shape)
             ng = eng._next_geometry(len(q), len(t), bi, band)
             if ng is None:
                 eng.stats["fallback_band"] += 1

@@ -1013,7 +1013,9 @@ class _ConsensusStream:
     def _bucket_L(self, w: "_Work", band: int) -> Optional[int]:
         """Power-of-two lane-width bucket for one window (None -> the
         window exceeds every device bucket and takes the CPU fallback,
-        the same reject contract as the padded path's global caps)."""
+        the same reject contract as the padded path's global caps).
+        The pow2 rule itself is the engine's shared
+        :meth:`TpuPoaConsensus.bucket_L_for`."""
         max_dev_L = (1 << 18) // (K_INS * CH) - GROW
         bb = len(w.backbone)
         if bb > max_dev_L:
@@ -1025,19 +1027,8 @@ class _ConsensusStream:
             if bb > max_dev_L + min(GROW, band):
                 return None
             bb = max_dev_L
-        L_req = max(256, bb, w.max_layer_len - band)
-        L = 256
-        while L < L_req:
-            if L >= max_dev_L:
-                return None
-            L = min(L * 2, max_dev_L)
-        return L
-
-    def _cap_pairs(self, L: int, band: int) -> int:
-        """Greedy-fill pair budget for a bucket (delegates to the
-        engine so the ragged path and the warm-up estimate share one
-        backpressure-aware formula)."""
-        return self.eng.cap_pairs_for(L, band)
+        return self.eng.bucket_L_for(max(256, bb,
+                                         w.max_layer_len - band))
 
     # ----------------------------------------------------------- dispatch
 
@@ -1077,7 +1068,9 @@ class _ConsensusStream:
 
         for L in list(self.pending):
             items = self.pending[L]
-            cap = self._cap_pairs(L, band)
+            # straight to the engine's shared formula (the ragged path
+            # and the warm-up estimate must read one cap rule)
+            cap = eng.cap_pairs_for(L, band)
             while items:
                 total = sum(w.n_layers for _, w in items)
                 if (total < cap and len(items) <= MAX_GROUP_WINDOWS
@@ -1340,6 +1333,23 @@ class TpuPoaConsensus(PallasDispatchMixin):
         return max(2048, min(self.arena_lanes_cap // (L + band),
                              4 * self.group_pairs_cap))
 
+    @staticmethod
+    def bucket_L_for(L_req: int) -> Optional[int]:
+        """THE power-of-two lane-width rule: the smallest pow2 bucket
+        >= ``L_req`` (floor 256), capped at the device insertion-payload
+        ceiling; None when it cannot fit.  Shared by the ragged
+        stream's per-window bucketing (``_ConsensusStream._bucket_L``)
+        and :meth:`_warmup_shapes`, so the dispatch and warm-up
+        geometries derive from one formula (the ``warmup-coverage``
+        lint checks exactly this)."""
+        max_dev_L = (1 << 18) // (K_INS * CH) - GROW
+        L = 256
+        while L < L_req:
+            if L >= max_dev_L:
+                return None
+            L = min(L * 2, max_dev_L)
+        return L
+
     def reduce_capacity(self) -> bool:
         """Halve the pair-arena/group capacity (device-OOM
         backpressure). Returns False once at the floor — the caller's
@@ -1497,9 +1507,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
             # bweights f32 ~ 13 bytes per backbone column, padded to
             # the worst group's power-of-two window count)
             max_wins = max(len(g) for g in groups)
-            nWp_max = 1
-            while nWp_max < max_wins + 1:
-                nWp_max *= 2
+            nWp_max = self._pow2_at_least(max_wins + 1)
             group_bytes = ((2 * Lq + 24) * self.group_pairs_cap
                            + 16 * Lb * nWp_max)
             inflight_cap = max(self.num_batches,
@@ -1629,9 +1637,9 @@ class TpuPoaConsensus(PallasDispatchMixin):
         # big runs. A run smaller than one arena dispatches a single
         # group of everything at the full round budget.
         max_dev_L = (1 << 18) // (K_INS * CH) - GROW
-        Ld = 256
-        while Ld < max(256, min(window_length, max_dev_L)):
-            Ld = min(Ld * 2, max_dev_L)
+        # the dominant bucket width through THE shared pow2 rule (the
+        # L_req is capped at the device ceiling, so this never rejects)
+        Ld = self.bucket_L_for(min(window_length, max_dev_L))
         cap = self.cap_pairs_for(Ld, band)
         if est_pairs > cap:
             wins = min(est_windows, max(1, int(cap / depth)),
@@ -1914,12 +1922,10 @@ class TpuPoaConsensus(PallasDispatchMixin):
 
         max_pairs = max(sum(w.n_layers for _, w in sh) for sh in shards)
         max_wins = max(len(sh) for sh in shards)
-        B = 1
-        while B < max(max_pairs, 1):
-            B *= 2
-        nWp = 1
-        while nWp < max_wins + 1:
-            nWp *= 2
+        # pow2 batch/window-count padding through the same helper the
+        # warm-up derivation uses (warmup-coverage keeps them shared)
+        B = self._pow2_at_least(max_pairs)
+        nWp = self._pow2_at_least(max_wins + 1)
 
         packs = [self._pack_shard(sh, Lq, B, nWp, Lb, overrides)
                  for sh in shards]

@@ -75,6 +75,13 @@ class RetraceBudgetExceeded(SanitizerError):
     """A pipeline phase compiled more new jit entries than its budget."""
 
 
+class CompileAfterWarmError(SanitizerError):
+    """An XLA compile was observed after the warm path was sealed —
+    the resident server's warm-path claim (jobs dispatch into a hot
+    jit cache) is violated; the message names the offending
+    (function, shape signature) next to the nearest warmed one."""
+
+
 def enabled() -> bool:
     """Master switch, read from the environment on every call so tests
     can toggle ``RACON_TPU_SANITIZE`` without re-importing."""
@@ -256,6 +263,23 @@ class PhaseRetraceBudget:
                 f"(budget {budget}) — a shape is leaking into the batch "
                 f"geometry and forcing silent recompiles")
         return False
+
+
+def check_post_warm_compiles(scope=None) -> list:
+    """The warm-path assert (round 18): raise
+    :class:`CompileAfterWarmError` when the process-wide compile watch
+    (:mod:`racon_tpu.obs.compilewatch`) recorded a compile after
+    :func:`~racon_tpu.obs.compilewatch.seal` — for the resident server
+    that means a job dispatched a geometry neither the warm-up profile
+    nor any earlier job compiled.  Armed only under
+    ``RACON_TPU_SANITIZE=1`` (the violations are warned and counted
+    either way); returns the violation records when not raising, so
+    unsanitized callers can surface them."""
+    from .obs import compilewatch
+    violations = compilewatch.post_warm(scope)
+    if violations and enabled():
+        raise CompileAfterWarmError(compilewatch.describe(violations))
+    return violations
 
 
 # -------------------------------------------------------- queue watchdog

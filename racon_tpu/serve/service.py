@@ -79,7 +79,7 @@ from ..exec import lease as lease_mod
 from ..exec.planner import estimate_job_cost, input_cost_bytes, parse_ram
 from ..exec.runner import _ChipWorker
 from ..io import parsers
-from ..obs import metrics, report as obs_report
+from ..obs import compilewatch, metrics, report as obs_report
 from ..parallel.topology import ChipSlot
 from ..utils.logger import log_swallowed, warn
 from . import protocol
@@ -112,39 +112,14 @@ def _eprint(msg: str) -> None:
     print(f"[racon_tpu::serve] {msg}", file=sys.stderr, flush=True)
 
 
-# ------------------------------------------------------ compile attribution
-
-_monitor_armed = False
-_monitor_lock = threading.Lock()
-
-
-def arm_compile_monitor() -> bool:
-    """Attribute real XLA compile seconds to the thread that compiles:
-    a ``jax.monitoring`` duration listener accumulates every
-    ``/jax/core/compile/*`` event into the ``compile.jax_s`` timer —
-    which, fired on a job's worker thread, lands in THAT job's metric
-    scope.  This is the measured numerator of
-    ``service_compile_fraction``; warm-up compiles run on unscoped
-    background threads and are deliberately not charged to any job."""
-    global _monitor_armed
-    with _monitor_lock:
-        if _monitor_armed:
-            return True
-        try:
-            import jax.monitoring as jmon
-
-            def _on_duration(event, duration, **kwargs):
-                if event.startswith("/jax/core/compile/"):
-                    metrics.add_time("compile.jax_s", duration)
-
-            jmon.register_event_duration_secs_listener(_on_duration)
-            _monitor_armed = True
-        except Exception as e:
-            log_swallowed(
-                "serve: jax.monitoring compile listener unavailable "
-                "(per-job compile_s will read 0)", e)
-            return False
-    return True
+# Compile attribution (round 18): the serve-only jax.monitoring
+# listener of round 14 is absorbed into the process-wide
+# racon_tpu.obs.compilewatch — same ``compile.jax_s`` scoped-timer
+# semantics (fired on a job's worker thread, the time lands in THAT
+# job's metric scope: the measured numerator of
+# ``service_compile_fraction``), plus per-compile attribution to
+# (function, shape signature, phase, scope) and the warm-path seal the
+# sanitized serve assert reads (``sanitize.check_post_warm_compiles``).
 
 
 def parse_warm_shapes(raw: str) -> List[Tuple[int, int, int, int]]:
@@ -194,6 +169,18 @@ class Job:
         self.started_at: Optional[float] = None
         self.wall_s = 0.0
         self.compile_s = 0.0
+        # compiles attributed to this job AFTER the server sealed its
+        # warm path (round 18) — 0 on the warm-path claim, reported in
+        # the result header and asserted by bench_service
+        self.compiles_after_warm = 0
+        # the warm-path assert only judges jobs that STARTED after the
+        # seal: a job already compiling when the first job completed
+        # must not be failed retroactively (concurrent submissions)
+        self.post_warm_eligible = False
+        # True when admission warm-up queued NEW shapes for this job's
+        # estimated geometry — a declared geometry expansion, exempt
+        # from the warm-path assert (see _warm_job_geometry)
+        self.warmup_declared = False
         self.done = threading.Event()
         # crash-safe serving (round 16): the client's idempotency key,
         # the spooled-result coordinates (name + CRC the fetch path
@@ -225,6 +212,7 @@ class Job:
         if self.state in _TERMINAL:
             out["wall_s"] = round(self.wall_s, 3)
             out["compile_s"] = round(self.compile_s, 3)
+            out["compiles_after_warm"] = self.compiles_after_warm
             out["bytes"] = self.result_bytes
         elif self.started_at is not None:
             out["wall_s"] = round(time.perf_counter() - self.started_at,
@@ -384,29 +372,37 @@ class PolishServer:
                 f"budget {self.budget_bytes >> 20} MB, "
                 f"{len(shapes)} warm shape profile(s)")
 
-    def _warm_job_geometry(self, spec: dict) -> None:
+    def _warm_job_geometry(self, spec: dict) -> bool:
         """Hand an admitted job's own (estimated) geometry to every
         slot's warm-up — shape-deduped in the engine, so a repeat
         geometry (the service's common case) is free and a genuinely
-        new one starts compiling while the job waits in queue."""
+        new one starts compiling while the job waits in queue.
+        Returns True when any engine queued NEW warm-up shapes: the
+        job declared a geometry expansion, and the warm-path assert
+        must not judge it (its dispatch legitimately races its own
+        warm-up thread for the compile)."""
         wl = spec["window_length"]
         read_bases = max(1, input_cost_bytes(spec["sequences"]) // 2)
         target_bases = max(
             1, input_cost_bytes(spec["target_sequences"]) // 2)
         est_pairs = max(1, read_bases // wl)
         est_windows = max(1, target_bases // wl)
+        queued_new = False
         for w in self._chip_slots():
             if w.engines is None:
                 continue
             warm = getattr(w.engines[1], "warmup_async", None)
             if warm is not None:
-                warm(wl, est_pairs, est_windows,
-                     est_contigs=max(1, min(est_windows, 8)))
+                queued_new |= warm(
+                    wl, est_pairs, est_windows,
+                    est_contigs=max(1, min(est_windows, 8))) is not None
             awarm = getattr(w.engines[0], "warmup_async", None)
             if awarm is not None:
                 # align-stream geometry (round 17): see _warm_pool —
                 # shape-deduped in the engine, so repeats are free
-                awarm(8 * wl, max(1, est_pairs // 8), window_length=wl)
+                queued_new |= awarm(8 * wl, max(1, est_pairs // 8),
+                                    window_length=wl) is not None
+        return queued_new
 
     # --------------------------------------------------------- admission
 
@@ -528,8 +524,11 @@ class PolishServer:
             self._queue.append(job)
             self._counts["submitted"] += 1
             self._cond.notify_all()
-        # outside the lock: warm-up geometry derivation stats files
-        self._warm_job_geometry(spec)
+        # outside the lock: warm-up geometry derivation stats files.
+        # A job whose estimate queued NEW warm-up shapes declared a
+        # geometry expansion — the warm-path assert must not judge it
+        # (it races its own admission warm-up thread for the compile)
+        job.warmup_declared = self._warm_job_geometry(spec)
         return job, None, False
 
     # ------------------------------------------------------ job execution
@@ -555,6 +554,9 @@ class PolishServer:
                         self._queue.pop(0)
                         job.state = RUNNING
                         job.worker = worker.worker
+                        job.post_warm_eligible = (
+                            compilewatch.sealed() is not None
+                            and not job.warmup_declared)
                         job.started_at = time.perf_counter()
                         self._running_cost += job.cost
                         # supervision handle: if this slot's thread
@@ -686,13 +688,29 @@ class PolishServer:
                     elif cls == faults.CLASS_OOM and not tier_cpu and \
                             worker.reduce_capacity():
                         att["action"] = "reduce-capacity"
+                        # the halved arenas dispatch NEW geometries by
+                        # design: this job leaves the warm-path claim
+                        # (the ladder contract is that it survives),
+                        # and the seal re-opens so the shrunk engine's
+                        # re-warm compiles land in the warmed set
+                        # instead of failing every subsequent sanitized
+                        # job — the next completed job re-seals
+                        job.post_warm_eligible = False
+                        compilewatch.unseal()
                         warn(f"job {job.id} device OOM ({err}) — "
                              f"halved worker {worker.worker}'s "
                              f"consensus arena/group capacity, "
-                             f"re-dispatching on the device")
+                             f"re-dispatching on the device "
+                             f"(warm-path seal re-opened)")
                     elif not tier_cpu:
                         tier_cpu = True
                         att["action"] = "cpu-retry"
+                        # off the warm path by definition: the failed
+                        # device attempt may have compiled, but the
+                        # ladder contract says this job completes on
+                        # the CPU engines — it is not judged by the
+                        # warm-path assert (its story is in `attempts`)
+                        job.post_warm_eligible = False
                         warn(f"job {job.id} attempt failed ({err}) — "
                              f"retrying on the CPU engines")
                     else:
@@ -702,6 +720,23 @@ class PolishServer:
                         break
             job.wall_s = time.perf_counter() - t0
             job.compile_s = metrics.timer_s(scope + "compile.jax_s")
+            # warm-path claim (round 18): compiles attributed to this
+            # job's scope after the server sealed warm-up are counted
+            # into the result header; under RACON_TPU_SANITIZE=1 they
+            # FAIL the job with the offending (function, signature)
+            # named next to the nearest warmed one.  Only jobs that
+            # STARTED after the seal are judged — a concurrent job
+            # already compiling when job #1 completed is not failed
+            # retroactively.
+            if job.post_warm_eligible:
+                try:
+                    viol = sanitize.check_post_warm_compiles(scope)
+                    job.compiles_after_warm = len(viol)
+                except sanitize.CompileAfterWarmError as e:
+                    job.compiles_after_warm = len(
+                        compilewatch.post_warm(scope))
+                    job.error = f"sanitized warm-path assert: {e}"
+                    blob = None
             if blob is not None:
                 if self._journal is not None:
                     # results spool to CRC-verified files, not RAM:
@@ -715,6 +750,14 @@ class PolishServer:
                     job.result_bytes = len(blob)
                 job.engine = "cpu-retry" if tier_cpu else "primary"
                 job.state = DONE
+                # first completed job = warm-up complete: every shape
+                # the startup profile, admission warm-ups and job #1
+                # compiled is now the warmed set, and any later compile
+                # of a never-seen (function, signature) is a warm-path
+                # violation (warned + counted; a hard job failure under
+                # RACON_TPU_SANITIZE=1)
+                compilewatch.seal(f"serve warm path "
+                                  f"(job {job.id} complete)")
             else:
                 job.state = FAILED
             # the per-job run report: built from THIS job's metric
@@ -724,6 +767,10 @@ class PolishServer:
                 "job", argv=[job.id, spec_summary(job.spec)],
                 started_unix=t_start, wall_s=job.wall_s,
                 phases=job.phases, scope=scope)
+            # judged (or ladder-exempted) and reported: drop this
+            # scope's violation records so the bounded global list
+            # never fills up and quietly stops flagging later jobs
+            compilewatch.clear_scope(scope)
         finally:
             metrics.set_scope(None)
             # the report snapshot above embeds everything the scope
@@ -1405,7 +1452,16 @@ class PolishServer:
     def serve_forever(self) -> int:
         """Bind, warm the pool, accept until :meth:`shutdown`.  Returns
         an exit code (0 on a clean stop)."""
-        arm_compile_monitor()
+        compilewatch.arm()
+        # a fresh server owns the process's warm-path state: re-open
+        # the seal and drop stale attribution — events/counts AND the
+        # registry's compile.* timers/counters, so a second in-process
+        # server does not report a predecessor's total_s next to
+        # count=0 (matters for in-process test servers sharing one
+        # interpreter; production runs one server per process, where
+        # this is a startup no-op)
+        compilewatch.reset()
+        metrics.clear("compile.")
         # span TIMERS must record for the life of the server: the
         # per-job dispatch/fetch split reads them through each job's
         # metric scope (ring-buffer tracing stays off — a long-lived

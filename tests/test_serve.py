@@ -547,3 +547,85 @@ def test_producer_thread_inherits_job_scope(short_tmp, monkeypatch):
     assert "queue.producer_wait_s" in rep["metrics"]["timers"]
     # ...and not leaked into the global namespace by the producer
     assert metrics.timer_s("queue.producer_wait_s") == 0.0
+
+
+# ------------------------------------------- sanitized serve warm path
+
+@pytest.mark.slow  # device-engine compiles; the CI resident-service shard runs it
+def test_serve_sanitized_warm_path_assert_fires_only_when_unwarmed(
+        short_tmp, monkeypatch):
+    """THE round-18 serve acceptance at test scale, on the device
+    engine under RACON_TPU_SANITIZE=1: job #1 compiles and seals the
+    warm path; job #2 (same spec) is warm — zero post-warm compiles,
+    succeeds; job #3 (a window length the warm set never saw,
+    admission warm-up parked) compiles a genuinely unwarmed geometry —
+    the sanitized assert FAILS it with the offending signature named
+    next to the nearest warmed one.  Defined LAST in this file on
+    purpose: it traces the same engine geometries the warm-path/
+    retrace asserts above rely on being cold."""
+    import racon_tpu.core.backends as backends_mod
+    import racon_tpu.ops.poa as poa_mod
+    from racon_tpu.obs import compilewatch, report
+
+    monkeypatch.setattr(poa_mod, "BAND", 64)  # small-geometry compiles
+    monkeypatch.setattr(backends_mod, "_auto_mesh", lambda mesh: None)
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    monkeypatch.setenv("RACON_TPU_SANITIZE", "1")
+    # headroom: job #1's cold compiles are the point, not a retrace bug
+    monkeypatch.setenv("RACON_TPU_SANITIZE_RETRACE_BUDGET", "512")
+    # park the SWAR shadow sampler: this test is about the warm-path
+    # assert, and shadow re-dispatches would compile int32 twins of
+    # every geometry (cost, and extra warmed shapes)
+    monkeypatch.setenv("RACON_TPU_SANITIZE_SAMPLE", "1000000")
+    # park the admission warm-up so job #3's new geometry is GENUINELY
+    # unwarmed (normally it would start compiling at admission)
+    monkeypatch.setattr(PolishServer, "_warm_job_geometry",
+                        lambda self, spec: None)
+
+    reads, paf, layout = _assembly(short_tmp, [2600], seed=23)
+    try:
+        with _Server(short_tmp, num_threads=2,
+                     consensus_backend="tpu") as server:
+            with ServiceClient(server.socket_path) as c:
+                # job #1: cold compiles, completes, seals the warm path
+                sub = c.submit(_spec(reads, paf, layout))
+                h1, p1 = c.result(sub["job"], timeout_s=600)
+                assert h1["ok"], h1
+                assert compilewatch.sealed() is not None
+
+                # job #2: identical spec — warm path, zero post-warm
+                sub = c.submit(_spec(reads, paf, layout))
+                h2, p2 = c.result(sub["job"], timeout_s=600)
+                assert h2["ok"], h2
+                assert h2["compiles_after_warm"] == 0
+                assert p2 == p1
+                # the schema-v7 job report carries the attribution
+                # section, clean for the repeat-shape job
+                rep2 = h2["report"]
+                assert report.validate_report(rep2) == []
+                assert rep2["schema_version"] == 7
+                assert rep2["compiles"]["post_warm"] == 0
+                assert rep2["compiles"]["sealed"] == 1
+
+                # job #3: a never-warmed window length -> new consensus
+                # geometry -> the sanitized warm-path assert fires
+                sub = c.submit(_spec(reads, paf, layout,
+                                     window_length=600))
+                h3, p3 = c.result(sub["job"], timeout_s=600)
+                assert not h3["ok"], h3
+                assert h3["state"] == "failed"
+                assert h3["compiles_after_warm"] >= 1
+                assert "warm-path assert" in h3["error"]
+                assert "nearest warmed" in h3["error"]
+
+                # the server survived the assert: a repeat of the WARM
+                # spec still succeeds
+                sub = c.submit(_spec(reads, paf, layout))
+                h4, p4 = c.result(sub["job"], timeout_s=600)
+                assert h4["ok"] and p4 == p1
+    finally:
+        # the seal and warmed set are process-global: a later in-process
+        # server resets them itself, but tests that read the watch
+        # directly must not inherit this one's
+        from racon_tpu.obs import compilewatch as _cw
+        _cw.reset()

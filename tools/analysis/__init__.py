@@ -2,16 +2,21 @@
 
 AST-based and repo-aware: rules consult a project-wide function index,
 jit-reachability with interprocedural taint, a logging-function
-closure, and (round 15) the concurrency layer — thread entry-point
+closure, (round 15) the concurrency layer — thread entry-point
 discovery, per-function execution contexts, lock inventories, guard
-regions and a blocking-call closure (see
+regions and a blocking-call closure — and (round 18) the
+compile-surface dataflow layer — shape/dtype-determining parameters
+of every jit root propagated up the call graph, with bounded/unbounded
+origin classification of the values reaching them (see
 :mod:`tools.analysis.astutil` / :mod:`tools.analysis.rules` /
-:mod:`tools.analysis.concurrency`).  Run it as::
+:mod:`tools.analysis.concurrency` /
+:mod:`tools.analysis.compilesurface`).  Run it as::
 
     python -m tools.analysis racon_tpu tests tools bench.py
     python -m tools.analysis --selftest        # fixture-based rule tests
     python -m tools.analysis --list            # rule inventory
-    python -m tools.analysis --json PATH       # machine-readable output
+    python -m tools.analysis --json PATH       # machine JSON on stdout
+    python -m tools.analysis --json out.json PATH   # ...to a CI artifact
 
 Suppression: a finding is silenced by a pragma **with a reason** on the
 finding line or the line above::
@@ -165,10 +170,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_selftest()
     quiet = "--quiet" in argv
     as_json = "--json" in argv
+    json_path: Optional[str] = None
+    if as_json:
+        # `--json FILE.json` writes the machine-readable record to a CI
+        # artifact file (diffable across runs) while the human findings
+        # keep printing; bare `--json` prints the JSON to stdout.  The
+        # artifact slot is STRICTLY `.json`-suffixed: any other token
+        # stays a scan path, so a mistyped tree fails the run loudly
+        # instead of being silently consumed as the output file.
+        i = argv.index("--json")
+        if i + 1 < len(argv) and argv[i + 1].endswith(".json"):
+            json_path = argv.pop(i + 1)
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
         print("usage: python -m tools.analysis [--selftest|--list|"
-              "--json] PATH [PATH...]", file=sys.stderr)
+              "--json [FILE.json]] PATH [PATH...]", file=sys.stderr)
         return 2
     try:
         reported, suppressed = run(paths)
@@ -178,12 +194,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if as_json:
         # machine-readable output for CI annotation/aggregation: every
         # finding (reported AND pragma-suppressed, distinguished by the
-        # pragma field) as one JSON object on stdout
+        # pragma field) as one JSON object
         import json
-        print(json.dumps({
+        blob = json.dumps({
             "findings": [f.as_dict() for f in reported],
             "suppressed": [f.as_dict() for f in suppressed],
-        }, indent=1))
+        }, indent=1)
+        if json_path is not None:
+            with open(json_path, "w", encoding="utf-8") as fh:
+                fh.write(blob + "\n")
+            for f in reported:
+                print(f)
+        else:
+            print(blob)
     else:
         for f in reported:
             print(f)

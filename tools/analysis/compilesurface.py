@@ -1,0 +1,846 @@
+"""The compile-surface dataflow pack (round 18).
+
+The repo's whole performance story — warm-path serve p50, the retrace
+budgets, the hand-derived ``_warmup_shapes`` for both streaming
+sessions — rests on an invariant nothing checked statically until now:
+every jit dispatch geometry is bounded, bucketed, and covered by
+warm-up, and no Python value leaks into a shape or dtype in a way that
+retraces per call.  This module adds the dataflow layer that makes
+those checks possible, plus the five rules built on it:
+
+| rule                | catches                                          |
+| ------------------- | ------------------------------------------------ |
+| jit-shape-hazard    | an unbounded value (raw length, ``len()`` of a   |
+|                     | runtime list, un-quantized arithmetic) reaching  |
+|                     | a shape/dtype-determining parameter of a jit     |
+|                     | root — every distinct value is a separate XLA    |
+|                     | compile                                          |
+| dtype-drift         | int16/uint16 SWAR lanes silently promoted to a   |
+|                     | wider dtype across an op boundary                |
+| jit-in-loop         | ``jax.jit`` (or a jit-decorated def) constructed |
+|                     | per loop iteration — a fresh wrapper has an      |
+|                     | empty cache, so every iteration recompiles       |
+| warmup-coverage     | a dispatch-path geometry derivation not mirrored |
+|                     | by the module's ``_warmup_shapes`` (an un-shared |
+|                     | helper, or an inline pow2 loop either side)      |
+| host-transfer-in-jit| implicit ``np.asarray``/``np.*`` on a tracer     |
+|                     | path — a host transfer inside a traced function  |
+
+The dataflow layer (:class:`CompileSurface`):
+
+- **shape-determining parameters** — starting from the jit roots
+  (``Project.roots()``): a root's ``static_argnames``, a Pallas
+  kernel's keyword-only statics, and any parameter that flows (through
+  the intraprocedural taint closure) into a shape slot — ``jnp.zeros``/
+  ``broadcast_to``/``reshape`` dims, ``dtype=`` kwargs, Pallas
+  ``grid=``/``BlockSpec`` arguments.  The property propagates *up* the
+  unambiguous call graph: a function that forwards its own parameter
+  into a shape-determining parameter of a callee is itself
+  shape-determining in that parameter (``_launch_chunk_impl(max_len=
+  ...)`` -> ``align_chain`` -> ``_nw_wavefront_kernel``).
+- **origin classification** (:meth:`CompileSurface.classify`) — where a
+  value passed at a dispatch site comes from: pow2 bucket quantizers
+  and the repo's geometry helpers (:data:`QUANTIZER_NAMES`, plus any
+  function whose body is a returned doubling loop), literals, module
+  constants and instance attributes (fixed per engine) are *bounded*;
+  raw lengths, ``len()`` of runtime collections and results of
+  unrecognized repo calls are *unbounded*.  Parameters are "forwarded"
+  — the finding lands at the caller that injects the unbounded value,
+  once, not at every hop of the chain.
+
+The runtime companion is ``racon_tpu/obs/compilewatch.py``: a
+process-wide ``jax.monitoring`` listener attributes every real XLA
+compile to (function, shape signature, phase, scope) — what these
+rules prove statically, that proves (and reports) dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import (FuncInfo, Module, Project, dotted, iter_own_calls,
+                      iter_own_nodes, last_segment, map_call_args)
+from .rules import Finding, Rule
+
+# ------------------------------------------------------------- vocabulary
+
+# array constructors whose leading positional argument is a shape (or a
+# per-dim size): a value flowing here determines the compiled geometry
+SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange", "iota",
+               "broadcast_to", "tile", "reshape"}
+# keyword names that are shape/dtype slots wherever they appear
+SHAPE_KWARGS = {"shape", "dtype", "grid", "new_sizes", "dimensions",
+                "num_warps", "block_shape"}
+# call names that are shape slots in every argument (Pallas geometry)
+SHAPE_CALLS_ALL_ARGS = {"BlockSpec", "GridSpec"}
+
+# The repo's geometry quantizers: functions whose results take few
+# distinct values per run by construction (pow2 rounding, bucket
+# tables, budget caps).  A value derived from one of these is bounded;
+# the set is curated per-repo (graftlint is repo-aware by design) and
+# extended structurally by :func:`_doubling_loop_helpers` — any
+# function that returns the target of a ``while X < ...: X *= 2``
+# loop is a quantizer too.
+QUANTIZER_NAMES = {
+    # ops/nw.py
+    "_pow2_at_least", "_sweep_bound", "_pad_batch", "_chunk_cap",
+    "_seed_geometry", "_next_geometry", "_bucket_index",
+    "chunk_dirs_budget",
+    # ops/poa.py
+    "_bucket_geometry", "_sweep_geometry", "cap_pairs_for",
+    "bucket_L_for",
+    # parallel/
+    "mesh_size",
+}
+
+# Boolean variant selectors: repo predicates whose result takes at most
+# two values, so a static/variant argument fed from one is bounded by
+# construction (the SWAR/Pallas availability probes).  Recognized by
+# naming convention — the same convention the probes follow.
+_PREDICATE_SUFFIXES = ("_ok", "_fits", "_choice", "_enabled")
+_PREDICATE_PREFIXES = ("is_", "has_", "use_")
+
+
+def _is_predicate_name(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    bare = name.lstrip("_")
+    return (name.endswith(_PREDICATE_SUFFIXES)
+            or bare.startswith(_PREDICATE_PREFIXES))
+
+# builtins that preserve boundedness when every argument is bounded
+PASSTHRU_CALLS = {"min", "max", "abs", "int", "round", "sorted", "tuple",
+                  "list", "divmod", "pow", "float", "bool"}
+# calls whose result varies with runtime data volume — the unbounded
+# primitives the issue class is about
+UNBOUNDED_CALLS = {"len", "sum", "range", "enumerate", "count",
+                   "perf_counter", "time", "monotonic"}
+
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+_MAX_DEPTH = 8
+
+
+def _direct_names(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _doubling_loops(fi: FuncInfo) -> List[ast.While]:
+    """``while X < ...: X *= 2`` loops in a function's own body — the
+    inline pow2-quantization idiom."""
+    out: List[ast.While] = []
+    for node in iter_own_nodes(fi.node):
+        if not isinstance(node, ast.While):
+            continue
+        test_names = _direct_names(node.test)
+        for child in ast.walk(node):
+            target: Optional[str] = None
+            if isinstance(child, ast.AugAssign) \
+                    and isinstance(child.op, ast.Mult) \
+                    and isinstance(child.target, ast.Name) \
+                    and isinstance(child.value, ast.Constant) \
+                    and child.value.value == 2:
+                target = child.target.id
+            elif isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                name = child.targets[0].id
+                for sub in ast.walk(child.value):
+                    if isinstance(sub, ast.BinOp) \
+                            and isinstance(sub.op, ast.Mult) \
+                            and ((isinstance(sub.left, ast.Name)
+                                  and sub.left.id == name
+                                  and isinstance(sub.right, ast.Constant)
+                                  and sub.right.value == 2)
+                                 or (isinstance(sub.right, ast.Name)
+                                     and sub.right.id == name
+                                     and isinstance(sub.left, ast.Constant)
+                                     and sub.left.value == 2)):
+                        target = name
+            if target is not None and target in test_names:
+                out.append(node)
+                break
+    return out
+
+
+def _returns_name(fi: FuncInfo, name: str) -> bool:
+    """Does the function return ``name`` directly (or as a top-level
+    tuple element)?  The helper-exemption for doubling loops: a
+    returned loop target makes the function itself the shared
+    quantizer; a loop whose result is consumed inline belongs in one."""
+    for node in iter_own_nodes(fi.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        v = node.value
+        elts = v.elts if isinstance(v, ast.Tuple) else [v]
+        for e in elts:
+            if isinstance(e, ast.Name) and e.id == name:
+                return True
+            if isinstance(e, ast.Call):
+                fn = last_segment(dotted(e.func))
+                if fn in PASSTHRU_CALLS and any(
+                        isinstance(a, ast.Name) and a.id == name
+                        for a in e.args):
+                    return True
+    return False
+
+
+# -------------------------------------------------------- dataflow layer
+
+class CompileSurface:
+    """Repo-wide compile-surface indexes, built lazily once per
+    project (rules share one instance via :func:`get_surface`)."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._shape_params: Optional[Dict[int, Dict[str, str]]] = None
+        self._quantizers: Optional[Set[str]] = None
+        self._jit_reaching: Optional[Set[int]] = None
+
+    # -------------------------------------------------------- quantizers
+
+    def quantizers(self) -> Set[str]:
+        """Names of the geometry-quantizer functions: the curated repo
+        set plus every function structurally recognized as a returned
+        doubling loop."""
+        if self._quantizers is not None:
+            return self._quantizers
+        names = set(QUANTIZER_NAMES)
+        for fi in self.project.functions:
+            for loop in _doubling_loops(fi):
+                tgt = self._loop_target(loop)
+                if tgt and _returns_name(fi, tgt):
+                    names.add(fi.name)
+        self._quantizers = names
+        return names
+
+    @staticmethod
+    def _loop_target(loop: ast.While) -> Optional[str]:
+        for child in ast.walk(loop):
+            if isinstance(child, ast.AugAssign) \
+                    and isinstance(child.target, ast.Name):
+                return child.target.id
+            if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                return child.targets[0].id
+        return None
+
+    # ------------------------------------------- shape-determining params
+
+    def shape_params(self) -> Dict[int, Dict[str, str]]:
+        """``id(FuncInfo) -> {param: why}`` for every function whose
+        parameter determines a compiled shape or dtype: jit roots
+        (statics + shape-slot flow) and the repo functions that forward
+        into them, to a fixpoint."""
+        if self._shape_params is not None:
+            return self._shape_params
+        project = self.project
+        marked: Dict[int, Dict[str, str]] = {}
+
+        for fi, _traced in project.roots():
+            params: Dict[str, str] = {}
+            if fi.is_jit_root:
+                for p in fi.static_argnames:
+                    params[p] = "a static_argnames entry"
+                # jit roots whose statics are keyword-only follow the
+                # Pallas convention even without static_argnames
+                if not fi.static_argnames:
+                    for p in fi.kwonly_params():
+                        params[p] = "a keyword-only static"
+            elif fi.is_kernel_root:
+                for p in fi.kwonly_params():
+                    params[p] = "Pallas keyword-only static geometry"
+            for p in fi.all_params():
+                if p in params or p in ("self", "cls"):
+                    continue
+                slot = self._flows_into_shape_slot(fi, p)
+                if slot:
+                    params[p] = f"flows into {slot}"
+            if params:
+                marked[id(fi)] = params
+
+        # propagate up the unambiguous call graph: a caller's own
+        # parameter forwarded (by direct name reference) into a marked
+        # parameter of a callee is itself shape-determining
+        for _ in range(20):
+            changed = False
+            for fi in project.functions:
+                own_params = set(fi.all_params()) - {"self", "cls"}
+                if not own_params:
+                    continue
+                for call in iter_own_calls(fi.node):
+                    callee = project.resolve_unique(call, fi)
+                    if callee is None or id(callee) not in marked:
+                        continue
+                    mapped = map_call_args(call, callee)
+                    for param in marked[id(callee)]:
+                        expr = mapped.get(param)
+                        if expr is None:
+                            continue
+                        for name in _direct_names(expr) & own_params:
+                            mine = marked.setdefault(id(fi), {})
+                            if name not in mine:
+                                mine[name] = (f"forwarded into "
+                                              f"`{callee.name}({param}=)`")
+                                changed = True
+            if not changed:
+                break
+        self._shape_params = marked
+        return marked
+
+    def _flows_into_shape_slot(self, fi: FuncInfo,
+                               param: str) -> Optional[str]:
+        derived = self.project._intra_taint(fi, {param})
+        for call in iter_own_calls(fi.node):
+            fn = dotted(call.func) or ""
+            seg = last_segment(fn) or ""
+            slots: List[ast.AST] = []
+            if seg in SHAPE_CTORS:
+                slots.extend(call.args[:1] if seg != "reshape"
+                             else call.args)
+            if seg in SHAPE_CALLS_ALL_ARGS:
+                slots.extend(call.args)
+            for kw in call.keywords:
+                if kw.arg in SHAPE_KWARGS:
+                    slots.append(kw.value)
+            for slot in slots:
+                if self._slot_names(slot) & derived:
+                    return f"`{seg}` dims/dtype"
+        return None
+
+    @staticmethod
+    def _slot_names(slot: ast.AST) -> Set[str]:
+        """Names a shape slot genuinely depends on: reads of an array's
+        own static geometry (``x.dtype`` as a ``dtype=`` kwarg,
+        ``x.shape[0]`` as a dim) do not make ``x`` shape-determining —
+        the array is a traced argument whose aval already keys the jit
+        cache."""
+        skip: Set[int] = set()
+        for n in ast.walk(slot):
+            if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+                for sub in ast.walk(n.value):
+                    skip.add(id(sub))
+        return {n.id for n in ast.walk(slot)
+                if isinstance(n, ast.Name) and id(n) not in skip}
+
+    # ------------------------------------------------------ jit reachability
+
+    def jit_reaching(self) -> Set[int]:
+        """ids of functions from which a jit/kernel root is reachable
+        over the unambiguous call graph — the dispatch paths whose
+        geometry derivations matter."""
+        if self._jit_reaching is not None:
+            return self._jit_reaching
+        project = self.project
+        # reversed edges: callee -> callers
+        callers: Dict[int, List[int]] = {}
+        for fi in project.functions:
+            for call in iter_own_calls(fi.node):
+                callee = project.resolve_unique(call, fi)
+                if callee is not None:
+                    callers.setdefault(id(callee), []).append(id(fi))
+        reaching: Set[int] = {id(fi) for fi in project.functions
+                              if fi.is_jit_root or fi.is_kernel_root}
+        work = list(reaching)
+        while work:
+            k = work.pop()
+            for caller in callers.get(k, ()):
+                if caller not in reaching:
+                    reaching.add(caller)
+                    work.append(caller)
+        self._jit_reaching = reaching
+        return reaching
+
+    # --------------------------------------------------- origin classification
+
+    def classify(self, fi: FuncInfo, expr: ast.AST,
+                 depth: int = 0) -> Tuple[bool, str, Set[str]]:
+        """Classify where a value comes from: ``(bounded, why,
+        helpers)``.  ``helpers`` collects the repo geometry functions
+        seen along the derivation (consumed by warmup-coverage).  When
+        unbounded, ``why`` names the offending source."""
+        helpers: Set[str] = set()
+        if depth > _MAX_DEPTH:
+            return True, "depth-capped", helpers
+        if isinstance(expr, ast.Constant):
+            return True, "literal", helpers
+        if isinstance(expr, ast.Name):
+            return self._classify_name(fi, expr.id, depth, helpers)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return True, "array geometry attribute", helpers
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id in ("self", "cls"):
+                return True, "instance attribute (fixed per engine)", \
+                    helpers
+            return self.classify(fi, expr.value, depth + 1)
+        if isinstance(expr, ast.Subscript):
+            return self.classify(fi, expr.value, depth + 1)
+        if isinstance(expr, ast.Call):
+            return self._classify_call(fi, expr, depth, helpers)
+        if isinstance(expr, ast.Compare):
+            # a comparison yields a boolean — two values, bounded no
+            # matter how its operands vary
+            return True, "boolean comparison", helpers
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.BoolOp,
+                             ast.IfExp, ast.Tuple, ast.List)):
+            for child in ast.iter_child_nodes(expr):
+                if not isinstance(child, ast.expr):
+                    continue
+                ok, why, h = self.classify(fi, child, depth + 1)
+                helpers |= h
+                if not ok:
+                    return False, why, helpers
+            return True, "arithmetic over bounded values", helpers
+        return True, "unmodelled expression", helpers
+
+    def _classify_name(self, fi, name, depth, helpers):
+        chain = [fi] + self.project.enclosing(fi)
+        for f in chain:
+            if name in f.all_params():
+                return True, "forwarded parameter (checked at callers)", \
+                    helpers
+        assigned = False
+        for f in chain:
+            for node in iter_own_nodes(f.node):
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    tnames: Set[str] = set()
+                    for t in node.targets:
+                        tnames |= _direct_names(t)
+                    if name in tnames:
+                        value = node.value
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                        and name in _direct_names(node.target):
+                    value = node.value
+                elif isinstance(node, ast.NamedExpr) \
+                        and isinstance(node.target, ast.Name) \
+                        and node.target.id == name:
+                    value = node.value
+                elif isinstance(node, (ast.For, ast.AsyncFor)) \
+                        and name in _direct_names(node.target):
+                    value = node.iter
+                if value is None:
+                    continue
+                assigned = True
+                ok, why, h = self.classify(f, value, depth + 1)
+                helpers |= h
+                if not ok:
+                    return False, f"`{name}` <- {why}", helpers
+        if assigned:
+            return True, f"`{name}` derives from bounded values", helpers
+        # unassigned: a module constant or an import — bounded (module
+        # constants are fixed at import; a rogue global would be
+        # assigned somewhere the project can see)
+        return True, f"`{name}` is a module-level constant/import", helpers
+
+    def _classify_call(self, fi, call, depth, helpers):
+        fn = dotted(call.func) or ""
+        seg = last_segment(fn) or ""
+        if seg in UNBOUNDED_CALLS:
+            return False, (f"`{seg}()` of runtime data — its value "
+                           f"varies per call"), helpers
+        if seg in self.quantizers():
+            helpers.add(seg)
+            return True, f"quantized by `{seg}()`", helpers
+        if seg in PASSTHRU_CALLS:
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                ok, why, h = self.classify(fi, a, depth + 1)
+                helpers |= h
+                if not ok:
+                    return False, why, helpers
+            return True, f"`{seg}()` of bounded values", helpers
+        if _is_predicate_name(seg):
+            return True, (f"boolean variant selector `{seg}()` "
+                          f"(at most two values)"), helpers
+        callee = self.project.resolve_unique(call, fi)
+        if callee is not None:
+            if callee.name in self.quantizers():
+                helpers.add(callee.name)
+                return True, f"quantized by `{callee.name}()`", helpers
+            return False, (f"result of `{callee.name}()`, which is not "
+                           f"a recognized geometry quantizer"), helpers
+        # unresolved foreign call: permissive — bounded iff its inputs are
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            ok, why, h = self.classify(fi, a, depth + 1)
+            helpers |= h
+            if not ok:
+                return False, why, helpers
+        return True, "foreign call over bounded values", helpers
+
+
+def get_surface(project: Project) -> CompileSurface:
+    surf = getattr(project, "_compile_surface", None)
+    if surf is None:
+        surf = project._compile_surface = CompileSurface(project)
+    return surf
+
+
+# -------------------------------------------------------- jit-shape-hazard
+
+class JitShapeHazardRule(Rule):
+    """An unbounded value reaching a shape/dtype-determining parameter
+    of a jit root (directly, or through the repo functions that forward
+    into one) recompiles the kernel for every distinct value — the
+    silent 30 s/chunk stealth tax the retrace budgets hunt at runtime.
+    Geometry must route through the pow2/bucket quantizers; a value
+    that is genuinely bounded for a non-obvious reason takes a reasoned
+    pragma."""
+
+    name = "jit-shape-hazard"
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        surface = get_surface(project)
+        marked = surface.shape_params()
+        out: List[Finding] = []
+        for fi in project.functions:
+            if fi.module is not module:
+                continue
+            for call in iter_own_calls(fi.node):
+                callee = project.resolve_unique(call, fi)
+                if callee is None or id(callee) not in marked:
+                    continue
+                mapped = map_call_args(call, callee)
+                for param, why in marked[id(callee)].items():
+                    expr = mapped.get(param)
+                    if expr is None:
+                        continue
+                    ok, uwhy, _h = surface.classify(fi, expr)
+                    if ok:
+                        continue
+                    out.append(self.finding(
+                        module, call,
+                        f"`{param}` of `{callee.qualname}` is "
+                        f"shape/dtype-determining ({why}) but receives "
+                        f"an unbounded value ({uwhy}) — every distinct "
+                        f"value is a separate XLA compile; quantize it "
+                        f"through a pow2/bucket helper (or pragma with "
+                        f"the bound)"))
+        return out
+
+
+# ------------------------------------------------------------ dtype-drift
+
+class DtypeDriftRule(Rule):
+    """int16/uint16 SWAR lanes silently promoted to a wider dtype by an
+    op that mixes them with an int32/int64 operand: the promotion
+    doubles lane width (halving VPU throughput) without any visible
+    cast, and downstream kernels keep computing — just slower and off
+    the packed path's bit-exactness contract.  Mixing must be explicit
+    (``.astype``); a deliberate widening boundary takes a reasoned
+    pragma."""
+
+    name = "dtype-drift"
+    NARROW = {"int16", "uint16"}
+    WIDE = {"int32", "uint32", "int64", "uint64"}
+    MIXERS = {"where", "minimum", "maximum", "add", "subtract",
+              "multiply", "bitwise_or", "bitwise_and", "bitwise_xor",
+              "left_shift", "right_shift"}
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/ops/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        for fi in project.functions:
+            if fi.module is not module:
+                continue
+            widths = self._name_widths(fi)
+            for node in iter_own_nodes(fi.node):
+                msg = self._drift(node, widths)
+                if msg:
+                    out.append(self.finding(module, node, msg))
+        return out
+
+    @classmethod
+    def _dtype_width(cls, expr: ast.AST) -> Optional[str]:
+        """"narrow"/"wide" for a dtype expression (``jnp.int16``,
+        ``np.uint16``, ``"int16"``), else None."""
+        name = None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            name = expr.value
+        else:
+            name = last_segment(dotted(expr))
+        if name in cls.NARROW:
+            return "narrow"
+        if name in cls.WIDE:
+            return "wide"
+        return None
+
+    @classmethod
+    def _call_width(cls, call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("astype", "view") and call.args:
+            return cls._dtype_width(call.args[0])
+        for kw in call.keywords:
+            if kw.arg == "dtype":
+                return cls._dtype_width(kw.value)
+        seg = last_segment(dotted(call.func))
+        if seg == "arange" and not any(kw.arg == "dtype"
+                                       for kw in call.keywords):
+            return "wide"  # jnp.arange defaults to int32 on int args
+        return None
+
+    def _name_widths(self, fi: FuncInfo) -> Dict[str, str]:
+        widths: Dict[str, str] = {}
+        for _ in range(4):
+            grew = False
+            for node in iter_own_nodes(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                w = self._expr_width(node.value, widths)
+                if w is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and widths.get(t.id) != w:
+                        widths[t.id] = w
+                        grew = True
+            if not grew:
+                break
+        return widths
+
+    def _expr_width(self, expr: ast.AST,
+                    widths: Dict[str, str]) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return widths.get(expr.id)
+        if isinstance(expr, ast.Call):
+            w = self._call_width(expr)
+            if w is not None:
+                return w
+            return None
+        if isinstance(expr, (ast.Attribute, ast.Subscript)):
+            return self._expr_width(expr.value, widths)
+        if isinstance(expr, ast.BinOp):
+            lw = self._expr_width(expr.left, widths)
+            rw = self._expr_width(expr.right, widths)
+            if "wide" in (lw, rw):
+                return "wide"
+            if "narrow" in (lw, rw):
+                return "narrow"
+        return None
+
+    def _drift(self, node: ast.AST,
+               widths: Dict[str, str]) -> Optional[str]:
+        operands: List[ast.AST] = []
+        what = None
+        if isinstance(node, ast.BinOp):
+            operands = [node.left, node.right]
+            what = "arithmetic"
+        elif isinstance(node, ast.Call):
+            fn = dotted(node.func) or ""
+            seg = last_segment(fn)
+            if seg not in self.MIXERS:
+                return None
+            args = list(node.args)
+            if seg == "where" and args:
+                args = args[1:]  # the condition is bool, not a lane
+            operands = args
+            what = f"`{seg}`"
+        else:
+            return None
+        seen = {self._expr_width(o, widths) for o in operands}
+        if "narrow" in seen and "wide" in seen:
+            return (f"int16/uint16 SWAR lane mixed with a wider operand "
+                    f"in {what} — the lane is silently promoted to "
+                    f"int32 across this op boundary (lane width doubles, "
+                    f"VPU throughput halves); widen explicitly with "
+                    f".astype or keep both operands narrow (or pragma "
+                    f"a deliberate boundary with the reason)")
+        return None
+
+
+# ------------------------------------------------------------ jit-in-loop
+
+class JitInLoopRule(Rule):
+    """``jax.jit`` called — or a jit-decorated def defined — inside a
+    loop body constructs a fresh jitted callable per iteration.  A
+    fresh wrapper has an empty cache: every iteration traces and
+    compiles again, a guaranteed cache miss that turns a warm loop into
+    a compile loop.  Hoist the jitted function out of the loop; a
+    deliberately per-iteration wrapper (a test probing compile
+    behaviour) takes a reasoned pragma."""
+
+    name = "jit-in-loop"
+    JIT_CALLS = {"jax.jit", "jit"}
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        from .astutil import _jit_decoration
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if node is loop or id(node) in seen:
+                    continue
+                if isinstance(node, ast.Call) \
+                        and dotted(node.func) in self.JIT_CALLS:
+                    seen.add(id(node))
+                    out.append(self.finding(
+                        module, node,
+                        "`jax.jit` constructed inside a loop — a fresh "
+                        "wrapper has an empty cache, so every iteration "
+                        "recompiles; hoist the jitted callable out of "
+                        "the loop (or pragma with the reason)"))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if _jit_decoration(dec) is not None:
+                            seen.add(id(node))
+                            out.append(self.finding(
+                                module, node,
+                                f"jit-decorated `{node.name}` defined "
+                                f"inside a loop — each iteration builds "
+                                f"a new jitted callable with an empty "
+                                f"cache; hoist the definition (or "
+                                f"pragma with the reason)"))
+                            break
+        return out
+
+
+# -------------------------------------------------------- warmup-coverage
+
+class WarmupCoverageRule(Rule):
+    """In a module that carries a ``_warmup_shapes`` derivation (the
+    device engines), every dispatch-path geometry derivation must be
+    *mirrored* by it — shared helpers, not parallel re-implementations.
+    Two drift shapes are caught: (a) a geometry helper called on a
+    jit-reaching dispatch path that ``_warmup_shapes`` never
+    (transitively) calls — the warm-up cannot mirror that dispatch
+    shape and the first real dispatch compiles cold; (b) an inline
+    ``while X < ...: X *= 2`` quantization loop (on either side) whose
+    logic necessarily drifts from the helper the other side uses.  The
+    ``_AlignStream``/``_ConsensusStream`` warm-up drift class of rounds
+    13-17, checked instead of re-derived by hand.  A deliberately
+    uncovered derivation (data-dependent escalation rungs) takes a
+    reasoned pragma."""
+
+    name = "warmup-coverage"
+    WARM_NAME = "_warmup_shapes"
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/ops/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        warm_roots = [fi for fi in project.functions
+                      if fi.module is module and fi.name == self.WARM_NAME]
+        if not warm_roots:
+            return []
+        surface = get_surface(project)
+        quantizers = surface.quantizers()
+        warm_names = self._closure_names(project, warm_roots)
+        reaching = surface.jit_reaching()
+        out: List[Finding] = []
+        for fi in project.functions:
+            if fi.module is not module:
+                continue
+            in_warm = fi.name in warm_names or self._under_warmup(fi)
+            # (b) inline doubling loops: flagged on BOTH sides (and in
+            # the pack path between them) — the quantization belongs in
+            # one shared helper
+            if fi.name not in quantizers:
+                for loop in _doubling_loops(fi):
+                    side = ("the warm-up derivation" if in_warm
+                            else "the dispatch/pack path")
+                    out.append(self.finding(
+                        module, loop,
+                        f"inline pow2 quantization in {side} "
+                        f"(`{fi.qualname}`) — extract the loop into "
+                        f"a helper shared with "
+                        f"{self.WARM_NAME} so the dispatch and "
+                        f"warm-up geometries cannot drift"))
+            if in_warm or id(fi) not in reaching:
+                continue
+            # (a) dispatch-path helpers the warm-up never calls
+            for call in iter_own_calls(fi.node):
+                callee = project.resolve_unique(call, fi)
+                if callee is None or callee.name not in quantizers:
+                    continue
+                if callee.name in warm_names:
+                    continue
+                out.append(self.finding(
+                    module, call,
+                    f"dispatch-path geometry in `{fi.qualname}` derives "
+                    f"via `{callee.name}()`, which {self.WARM_NAME} "
+                    f"never calls — warm-up cannot mirror this dispatch "
+                    f"shape and its first real dispatch compiles cold "
+                    f"(share the helper, or pragma why the geometry is "
+                    f"covered)"))
+        return out
+
+    @staticmethod
+    def _closure_names(project: Project,
+                       roots: List[FuncInfo]) -> Set[str]:
+        names: Set[str] = set()
+        work = list(roots)
+        seen: Set[int] = set()
+        while work:
+            fi = work.pop()
+            if id(fi) in seen:
+                continue
+            seen.add(id(fi))
+            names.add(fi.name)
+            for call in iter_own_calls(fi.node):
+                callee = project.resolve_unique(call, fi)
+                if callee is not None and id(callee) not in seen:
+                    work.append(callee)
+        return names
+
+    @staticmethod
+    def _under_warmup(fi: FuncInfo) -> bool:
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            if "warmup" in cur.name or cur.name.startswith("_warm"):
+                return True
+            cur = cur.parent
+        return False
+
+
+# --------------------------------------------------- host-transfer-in-jit
+
+class HostTransferInJitRule(Rule):
+    """A ``np.*`` call on a traced value inside a jit-reachable
+    function is an implicit host transfer: at trace time it either
+    fails outright or silently concretizes one batch's values into the
+    compiled program (the sibling of tracer-leak's explicit casts, via
+    numpy's __array__ protocol instead).  Device code computes with
+    ``jnp``; host fetches happen after dispatch, through the sanctioned
+    fetch paths — never inside a traced function."""
+
+    name = "host-transfer-in-jit"
+    NP_PREFIXES = ("np.", "numpy.")
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith("racon_tpu/") and rel.endswith(".py")
+
+    def check(self, project: Project, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        taints = project.taints()
+        for fi in project.functions:
+            if fi.module is not module or id(fi) not in taints:
+                continue
+            tainted = taints[id(fi)]
+            for call in iter_own_calls(fi.node):
+                fn = dotted(call.func) or ""
+                if not fn.startswith(self.NP_PREFIXES):
+                    continue
+                args = list(call.args) + [kw.value for kw in
+                                          call.keywords]
+                if any(project.expr_tainted(a, tainted) for a in args):
+                    out.append(self.finding(
+                        module, call,
+                        f"`{fn}` on a traced value in jit-reachable "
+                        f"`{fi.qualname}` — an implicit host transfer "
+                        f"on the tracer path (fails at trace time or "
+                        f"bakes one batch's values into the compiled "
+                        f"program); compute with jnp, fetch after "
+                        f"dispatch"))
+        return out
+
+
+COMPILE_SURFACE_RULES = [JitShapeHazardRule(), DtypeDriftRule(),
+                         JitInLoopRule(), WarmupCoverageRule(),
+                         HostTransferInJitRule()]

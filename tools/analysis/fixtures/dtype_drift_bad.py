@@ -1,0 +1,24 @@
+"""Seeded dtype-drift violations (expect 3): int16/uint16 SWAR lanes
+silently promoted to int32 across an op boundary."""
+import jax.numpy as jnp
+
+
+def mix_binop(x):
+    lanes = x.astype(jnp.int16)
+    wide = jnp.arange(8)              # int32 by default
+    # BAD: silent int16 -> int32 promotion in arithmetic
+    return lanes + wide
+
+
+def mix_where(mask, x, y):
+    a = x.astype(jnp.uint16)
+    b = y.astype(jnp.int32)
+    # BAD: the uint16 lane silently widens to match b
+    return jnp.where(mask, a, b)
+
+
+def mix_minimum(x):
+    a = jnp.zeros((4,), dtype=jnp.int16)
+    b = jnp.zeros((4,), dtype=jnp.int32)
+    # BAD: min over mixed widths promotes the packed lane
+    return jnp.minimum(a, b)

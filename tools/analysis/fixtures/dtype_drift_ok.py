@@ -1,0 +1,23 @@
+"""Clean twin of dtype_drift_bad (expect 0 reported, 1 suppressed):
+explicit widening, all-narrow arithmetic, and a reasoned pragma on a
+deliberate accumulator boundary."""
+import jax.numpy as jnp
+
+
+def explicit_widen(x):
+    lanes = x.astype(jnp.int16)
+    wide = jnp.arange(8)
+    return lanes.astype(jnp.int32) + wide
+
+
+def stays_narrow(x, y):
+    a = x.astype(jnp.int16)
+    b = y.astype(jnp.int16)
+    return jnp.minimum(a, b)
+
+
+def deliberate_boundary(x):
+    votes = x.astype(jnp.uint16)
+    acc = jnp.zeros((8,), dtype=jnp.int32)
+    # graftlint: disable=dtype-drift (accumulator boundary: the promotion to int32 is the point)
+    return acc + votes

@@ -1,0 +1,28 @@
+"""Seeded host-transfer-in-jit violations (expect 3): implicit
+np.asarray/np.* on traced values inside jit-reachable functions —
+directly and through an interprocedural call."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kernel(x, *, k):
+    y = jnp.cumsum(x)
+    # BAD: np on a tracer — implicit host transfer at trace time
+    host = np.asarray(y)
+    # BAD: np reduction of a traced value
+    peak = np.max(y)
+    return x + host[0] + peak + k
+
+
+def helper(v):
+    # BAD: reached with a traced argument from kernel2
+    return np.ascontiguousarray(v)
+
+
+@jax.jit
+def kernel2(x):
+    return helper(x * 2)

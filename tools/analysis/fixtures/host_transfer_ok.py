@@ -1,0 +1,26 @@
+"""Clean twin of host_transfer_bad (expect 0 reported, 1 suppressed):
+np on static values inside jit, sanctioned host-side fetches outside
+it, and a reasoned pragma on an interpret-mode probe."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def kernel(x, *, k):
+    # np over a STATIC argument builds a compile-time table — fine
+    table = np.arange(k)
+    return x + jnp.asarray(table)[0]
+
+
+def fetch(out):
+    # host-side fetch after dispatch: not jit-reachable, not flagged
+    return np.asarray(out)
+
+
+@jax.jit
+def probe(x):
+    # graftlint: disable=host-transfer-in-jit (interpret-mode identity probe)
+    return np.asarray(x)

@@ -1,0 +1,33 @@
+"""Seeded jit-in-loop violations (expect 3): jit wrappers constructed
+per loop iteration — every iteration recompiles into an empty cache."""
+import jax
+
+
+def per_iteration(fns, xs):
+    out = []
+    for f, x in zip(fns, xs):
+        # BAD: fresh jit wrapper (and cache) per iteration
+        jf = jax.jit(f)
+        out.append(jf(x))
+    return out
+
+
+def nested_def(xs):
+    res = []
+    for x in xs:
+        # BAD: a jit-decorated def per iteration
+        @jax.jit
+        def step(v):
+            return v * 2
+
+        res.append(step(x))
+    return res
+
+
+def while_retrace(x):
+    k = 0
+    while k < 3:
+        # BAD: the closure over k builds a new wrapper each pass
+        x = jax.jit(lambda v: v + k)(x)
+        k += 1
+    return x

@@ -1,0 +1,27 @@
+"""Clean twin of jit_in_loop_bad (expect 0 reported, 1 suppressed):
+hoisted jit callables called from loops, plus a reasoned pragma on a
+deliberate compile-behaviour probe."""
+import jax
+
+
+@jax.jit
+def step(v):
+    return v * 2
+
+
+def hoisted(xs):
+    return [step(x) for x in xs]
+
+
+def loop_calls(xs):
+    out = []
+    for x in xs:
+        out.append(step(x))
+    return out
+
+
+def probe(xs):
+    for x in xs:
+        # graftlint: disable=jit-in-loop (compile-behaviour probe: single iteration by construction)
+        f = jax.jit(lambda v: v)
+        return f(x)

@@ -1,0 +1,40 @@
+"""Seeded jit-shape-hazard violations (expect 3): unbounded values
+reaching shape-determining parameters of a jit root — directly and
+through a forwarding function."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def kernel(x, *, max_len, band):
+    pad = jnp.zeros((max_len + band,), jnp.int32)
+    return x + pad[0]
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def scaled(x, *, scale):
+    return x * jnp.full((4,), scale)
+
+
+def launch(x, max_len, band):
+    # forwards into the kernel statics: shape-determining by propagation
+    return kernel(x, max_len=max_len, band=band)
+
+
+def drive_raw_len(x, pairs):
+    # BAD: len() of a runtime list reaches max_len through launch()
+    return launch(x, len(pairs), 64)
+
+
+def drive_unquantized(x, pairs):
+    total = sum(len(p) for p in pairs)
+    # BAD: un-quantized aggregate reaches the kernel's static directly
+    return kernel(x, max_len=total, band=64)
+
+
+def drive_clock(x):
+    # BAD: a per-call varying value as a compiled static
+    return scaled(x, scale=int(time.monotonic()))

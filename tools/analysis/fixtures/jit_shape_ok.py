@@ -1,0 +1,40 @@
+"""Clean twin of jit_shape_bad (expect 0 reported, 1 suppressed):
+geometry quantized through pow2/bucket helpers, module constants and a
+reasoned pragma for the deliberate exception."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+BUCKET_BAND = 512
+
+
+def _pow2_at_least(x):
+    p = 1
+    while p < max(1, x):
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit, static_argnames=("max_len", "band"))
+def kernel(x, *, max_len, band):
+    pad = jnp.zeros((max_len + band,), jnp.int32)
+    return x + pad[0]
+
+
+def launch(x, max_len, band):
+    return kernel(x, max_len=max_len, band=band)
+
+
+def drive_quantized(x, pairs):
+    B = _pow2_at_least(len(pairs))
+    return launch(x, B, BUCKET_BAND)
+
+
+def drive_constant(x):
+    return kernel(x, max_len=256, band=BUCKET_BAND)
+
+
+def drive_probe(x, pairs):
+    # graftlint: disable=jit-shape-hazard (availability probe: runs once per process)
+    return kernel(x, max_len=len(pairs), band=64)

@@ -1,0 +1,39 @@
+"""Seeded warmup-coverage violations (expect 3): a dispatch-path
+geometry helper _warmup_shapes never calls, plus inline pow2
+quantization loops on both the dispatch and warm-up sides."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _kernel(x, *, max_len):
+    return x + jnp.zeros((max_len,), jnp.int32)[0]
+
+
+def _dispatch_cap(n):
+    """A geometry quantizer only the dispatch path uses."""
+    c = 64
+    while c < n:
+        c *= 2
+    return c
+
+
+class Engine:
+    def _warmup_shapes(self, est):
+        # BAD: inline pow2 loop on the warm-up side — parallel
+        # re-implementation of the dispatch derivation
+        B = 1
+        while B < est:
+            B *= 2
+        return [(B,)]
+
+    def dispatch(self, x, items):
+        # BAD: helper not (transitively) called by _warmup_shapes
+        max_len = _dispatch_cap(len(items))
+        # BAD: inline pow2 loop on the dispatch path
+        B = 1
+        while B < len(items):
+            B *= 2
+        return _kernel(x, max_len=max_len)

@@ -1,0 +1,42 @@
+"""Clean twin of warmup_coverage_bad (expect 0 reported, 1
+suppressed): one shared geometry helper on both sides, and a reasoned
+pragma on the data-dependent escalation derivation."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("max_len",))
+def _kernel(x, *, max_len):
+    return x + jnp.zeros((max_len,), jnp.int32)[0]
+
+
+def _shared_cap(n):
+    """THE pow2 rule — dispatch and warm-up both call it."""
+    c = 64
+    while c < n:
+        c *= 2
+    return c
+
+
+def _escape_cap(n):
+    """Escalation geometry: deliberately uncovered (data-dependent)."""
+    c = 128
+    while c < n:
+        c *= 2
+    return c
+
+
+class Engine:
+    def _warmup_shapes(self, est):
+        return [(_shared_cap(est),)]
+
+    def dispatch(self, x, items):
+        max_len = _shared_cap(len(items))
+        return _kernel(x, max_len=max_len)
+
+    def escalate(self, x, items):
+        # graftlint: disable=warmup-coverage (escalation shapes are data-dependent and rare by design)
+        max_len = _escape_cap(2 * len(items))
+        return _kernel(x, max_len=max_len)

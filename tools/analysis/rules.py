@@ -24,7 +24,10 @@ closure built in :mod:`tools.analysis.astutil`.
 The concurrency & durability pack (round 15) lives in
 :mod:`tools.analysis.concurrency` and registers below: lock-discipline,
 blocking-under-lock, atomic-write-discipline, thread-lifecycle and
-scope-discipline — 11 rules total.
+scope-discipline.  The compile-surface pack (round 18) lives in
+:mod:`tools.analysis.compilesurface` and registers below too:
+jit-shape-hazard, dtype-drift, jit-in-loop, warmup-coverage and
+host-transfer-in-jit — 16 rules total.
 """
 
 from __future__ import annotations
@@ -499,12 +502,13 @@ class SpanDisciplineRule(Rule):
         return out
 
 
-# imported at the bottom so the concurrency pack can subclass Rule /
-# build Findings without a circular import (both names are bound above
-# by the time this line runs)
+# imported at the bottom so the concurrency and compile-surface packs
+# can subclass Rule / build Findings without a circular import (both
+# names are bound above by the time these lines run)
+from .compilesurface import COMPILE_SURFACE_RULES  # noqa: E402
 from .concurrency import CONCURRENCY_RULES  # noqa: E402
 
 ALL_RULES = [TracerLeakRule(), SwarGuardRule(), SwallowedExceptionRule(),
              EnvFlagRegistryRule(), HostSyncRule(), SpanDisciplineRule(),
-             *CONCURRENCY_RULES]
+             *CONCURRENCY_RULES, *COMPILE_SURFACE_RULES]
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

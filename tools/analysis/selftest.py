@@ -42,6 +42,17 @@ EXPECT = {
     "thread_lifecycle_ok.py": ("thread-lifecycle", 0, 1),
     "scope_discipline_bad.py": ("scope-discipline", 3, 0),
     "scope_discipline_ok.py": ("scope-discipline", 0, 1),
+    # the compile-surface pack (round 18)
+    "jit_shape_bad.py": ("jit-shape-hazard", 3, 0),
+    "jit_shape_ok.py": ("jit-shape-hazard", 0, 1),
+    "dtype_drift_bad.py": ("dtype-drift", 3, 0),
+    "dtype_drift_ok.py": ("dtype-drift", 0, 1),
+    "jit_in_loop_bad.py": ("jit-in-loop", 3, 0),
+    "jit_in_loop_ok.py": ("jit-in-loop", 0, 1),
+    "warmup_coverage_bad.py": ("warmup-coverage", 3, 0),
+    "warmup_coverage_ok.py": ("warmup-coverage", 0, 1),
+    "host_transfer_bad.py": ("host-transfer-in-jit", 3, 0),
+    "host_transfer_ok.py": ("host-transfer-in-jit", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }
