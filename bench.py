@@ -72,6 +72,13 @@ def bench_consensus(windows):
     log(f"cpu: {cpu_t:.2f}s")
     stats = dict(tpu.stats)
     stats["pack"] = tpu.pack_metrics()
+    # per-window fold-overflow attribution (round 19): empty on the
+    # matmul path (overflow is structurally impossible there); on the
+    # scatter path it names the offending window ids instead of the
+    # old opaque event total
+    stats["ins_overflow_by_window"] = {
+        str(k): v for k, v in
+        getattr(tpu, "ins_overflow_by_window", {}).items()}
     return cold, warm, cpu_t, stats
 
 
@@ -496,6 +503,10 @@ def bench_pipeline():
         # heartbeat and the run report do)
         from racon_tpu.obs import metrics as obs_metrics
         retrace = obs_metrics.group("retrace.")
+        # resident-dataflow accounting (round 19): bytes fetched vs
+        # host round-trips avoided, host-fallback pairs, device-lane
+        # consensus groups — all zeros with RACON_TPU_RESIDENT off
+        dataflow = obs_metrics.dataflow_summary()
         # quality gate on a truth-prefix slice (coordinates drift with
         # indels, so compare a bounded prefix with the full Myers NW)
         probe = min(100_000, len(truths[0]))
@@ -511,7 +522,8 @@ def bench_pipeline():
                     align_pack=(p.aligner.pack_metrics()
                                 if hasattr(p.aligner, "pack_metrics")
                                 else {}),
-                    retrace=retrace, err_after=err_after,
+                    retrace=retrace, dataflow=dataflow,
+                    err_after=err_after,
                     err_before=err_before, probe=probe,
                     n_polished=len(polished), pol0=pol0)
 
@@ -574,6 +586,56 @@ def bench_pipeline():
         f"({align_ab_metrics['pipeline_align_work_reduction']:.1%} "
         f"reduction), output byte-identical")
 
+    # round-19 resident-dataflow A/B (RACON_TPU_BENCH_RESIDENT=0
+    # disables): the same workload with RACON_TPU_RESIDENT=1 — breaking
+    # points stay on device, window assignment + layer rows derive on
+    # device, and the consensus engine gathers its qpw lanes from the
+    # device-resident pool. Polished bytes must be IDENTICAL to the
+    # host path (the resident path's contract is byte-parity, not
+    # approximation); the recorded numbers are the collapsed init
+    # breakdown (align_fetch_s / bp_decode_s / build_windows_s vs the
+    # new window_derive_s) plus the dataflow bytes ledger.
+    resident_metrics = {}
+    if racon_flags.get_bool("RACON_TPU_BENCH_RESIDENT"):
+        log(f"pipeline bench: {mbp} Mbp resident-dataflow A/B...")
+        os.environ["RACON_TPU_RESIDENT"] = "1"
+        try:
+            res = run_once(mbp, seed=23, backend="tpu", batches=4)
+        finally:
+            os.environ.pop("RACON_TPU_RESIDENT", None)
+        assert res["pol0"] == tpu["pol0"], \
+            "resident dataflow diverged from the host align→consensus path"
+        if racon_flags.get_bool("RACON_TPU_BENCH_FUSED") and fused_metrics:
+            assert res["pol0"] == fused["pol0"], \
+                "resident dataflow diverged from the fused run() output"
+        df = res["dataflow"]
+        tm = res["timings"]
+        host_tm = tpu["timings"]
+        collapsed = (host_tm.get("align_fetch_s", 0.0)
+                     + host_tm.get("bp_decode_s", 0.0)
+                     + host_tm.get("build_windows_s", 0.0))
+        resident_now = (tm.get("align_fetch_s", 0.0)
+                        + tm.get("bp_decode_s", 0.0)
+                        + tm.get("build_windows_s", 0.0)
+                        + tm.get("window_derive_s", 0.0))
+        resident_metrics = {
+            "pipeline_resident_total_s": round(res["total_s"], 2),
+            "pipeline_resident_mbp_per_sec": round(
+                mbp / res["total_s"], 4),
+            "pipeline_resident_vs_host": round(
+                tpu["total_s"] / res["total_s"], 3),
+            "pipeline_resident_init_breakdown": tm,
+            # the handoff cost the tentpole attacks, host vs resident
+            "pipeline_resident_handoff_host_s": round(collapsed, 3),
+            "pipeline_resident_handoff_s": round(resident_now, 3),
+            "pipeline_resident_dataflow": df,
+        }
+        log(f"pipeline resident: {res['total_s']:.1f}s "
+            f"({mbp / res['total_s']:.3f} Mbp/s, host was "
+            f"{tpu['total_s']:.1f}s), handoff {collapsed:.2f}s -> "
+            f"{resident_now:.2f}s, fetched {df['bytes_fetched']} B, "
+            f"avoided {df['bytes_avoided']} B, output byte-identical")
+
     cpu_mbp = min(1.0, mbp)
     log(f"pipeline bench: {cpu_mbp} Mbp CPU-engine baseline...")
     cpu = run_once(cpu_mbp, seed=29, backend="cpu", batches=1)
@@ -598,6 +660,7 @@ def bench_pipeline():
         "pipeline_mbp_per_sec": round(tput, 4),
         **fused_metrics,
         **align_ab_metrics,
+        **resident_metrics,
         "pipeline_cpu_mbp": cpu_mbp,
         "pipeline_cpu_total_s": round(cpu["total_s"], 2),
         "pipeline_cpu_mbp_per_sec": round(cput, 4),

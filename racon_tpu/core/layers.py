@@ -43,10 +43,10 @@ class LayerStore:
     phred-33 clipped at 0, or 1 for no-quality reads)."""
 
     __slots__ = ("pool", "qpool", "qpw_pool", "src", "length", "begin",
-                 "end", "win_id", "has_qual", "row_bounds")
+                 "end", "win_id", "has_qual", "row_bounds", "dev_qpw")
 
     def __init__(self, pool, qpool, qpw_pool, src, length, begin, end,
-                 win_id, has_qual, row_bounds):
+                 win_id, has_qual, row_bounds, dev_qpw=None):
         self.pool = pool
         self.qpool = qpool
         self.qpw_pool = qpw_pool
@@ -57,6 +57,11 @@ class LayerStore:
         self.win_id = win_id
         self.has_qual = has_qual
         self.row_bounds = row_bounds
+        # device-resident copy of qpw_pool (round 19): when the resident
+        # dataflow built this store it uploaded the packed pool once, and
+        # the consensus packer gathers lanes on device instead of
+        # re-uploading host-gathered [B, Lq] blocks per group
+        self.dev_qpw = dev_qpw
 
     @property
     def n_rows(self) -> int:
@@ -75,9 +80,30 @@ class LayerStore:
         read set (forward or reverse-complement orientation); the pool
         deduplicates them by object identity, so a read orientation
         referenced by many overlaps is pooled once."""
-        n_ov = len(data_refs)
         ov = np.asarray(ov, np.int64)
         used = np.unique(ov) if len(ov) else np.zeros(0, np.int64)
+        (pool, qpool, qpw_pool, ov_off, hq_ov,
+         _has_q_base) = cls._build_pool(data_refs, qual_refs, used)
+
+        src = ov_off[ov] + np.asarray(qb, np.int64)
+        length = (np.asarray(qe, np.int64)
+                  - np.asarray(qb, np.int64)).astype(np.int64)
+        row_bounds = np.searchsorted(
+            np.asarray(win_id, np.int64), np.arange(n_windows + 1))
+        return cls(pool, qpool, qpw_pool, src, length,
+                   np.asarray(begin, np.int64), np.asarray(end, np.int64),
+                   np.asarray(win_id, np.int64), hq_ov[ov], row_bounds)
+
+    @classmethod
+    def _build_pool(cls, data_refs: Sequence[bytes],
+                    qual_refs: Sequence[Optional[bytes]],
+                    used: np.ndarray):
+        """Identity-deduplicated byte/quality/packed-lane pool over the
+        overlap indices in ``used`` — the shared core of :meth:`build`
+        and the device-resident assemble path (which pools every overlap
+        up front, before the device filter decides which rows survive).
+        Returns ``(pool, qpool, qpw_pool, ov_off, hq_ov, has_q_base)``."""
+        n_ov = len(data_refs)
         off_of_obj = {}
         parts: List[bytes] = []
         qparts: List[bytes] = []
@@ -113,15 +139,7 @@ class LayerStore:
             np.maximum(qpool.astype(np.int16) - 33, 0), 1)
         qpw_pool = ((weights.astype(np.uint16) << 3)
                     | _CODE_LUT[pool]).astype(np.uint16)
-
-        src = ov_off[ov] + np.asarray(qb, np.int64)
-        length = (np.asarray(qe, np.int64)
-                  - np.asarray(qb, np.int64)).astype(np.int64)
-        row_bounds = np.searchsorted(
-            np.asarray(win_id, np.int64), np.arange(n_windows + 1))
-        return cls(pool, qpool, qpw_pool, src, length,
-                   np.asarray(begin, np.int64), np.asarray(end, np.int64),
-                   np.asarray(win_id, np.int64), hq_ov[ov], row_bounds)
+        return pool, qpool, qpw_pool, ov_off, hq_ov, has_q_base
 
     # ------------------------------------------------------ device packing
 

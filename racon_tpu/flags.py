@@ -77,6 +77,16 @@ REGISTRY: Dict[str, Flag] = _declare([
          "escapees re-dispatch batched at the rung >= 2x the failed "
          "band; set 0 to start every pair at its bucket's full band "
          "for A/B measurement."),
+    Flag("RACON_TPU_RESIDENT", "0", "bool",
+         "Device-resident align->consensus dataflow: accepted breaking-"
+         "point tables stay on device, window assignment and per-window "
+         "layer rows are derived by jit'd array ops (min-span + "
+         "mean-PHRED filters, window arithmetic, stable argsort), and "
+         "the consensus engine gathers weight<<3|code lanes from the "
+         "device-resident pool instead of re-uploading host-packed "
+         "lanes. Byte-identical to the host path (the parity oracle); "
+         "falls back per-run when a precondition fails (mesh sharding, "
+         "fractional quality threshold, sub-33 quality bytes)."),
     Flag("RACON_TPU_RAGGED", "1", "bool",
          "Ragged window packing in the consensus engine: windows bucket "
          "by their own size and groups greedy-fill a fixed lane arena "
@@ -240,6 +250,10 @@ REGISTRY: Dict[str, Flag] = _declare([
     Flag("RACON_TPU_BENCH_FUSED", "1", "bool",
          "bench.py fused run()-vs-split A/B (and its bit-identity "
          "assert); set 0 to skip."),
+    Flag("RACON_TPU_BENCH_RESIDENT", "1", "bool",
+         "bench.py resident-dataflow A/B (RACON_TPU_RESIDENT=1 vs the "
+         "host align->consensus handoff, with its byte-identity assert "
+         "and the dataflow bytes ledger); set 0 to skip."),
     Flag("RACON_TPU_BENCH_SHARDS", "100", "float",
          "bench.py streaming shard-runner workload size in Mbp for the "
          "scaling-curve entry (includes a 4-shard-vs-single-shot "

@@ -58,7 +58,17 @@ from . import compilewatch, metrics
 # the trailing attributed events, each carrying (function, shape
 # signature, phase, duration).  Per-job reports filter all of it to
 # the job's scope.
-SCHEMA_VERSION = 7
+# v8 (round 19): the "dataflow" section became required — device-
+# resident align→consensus accounting (``dataflow.*`` metrics): was
+# the resident path live ("resident" gauge), bytes actually fetched
+# from device (final layer tables + consensus bytes) vs bytes whose
+# host round-trip was avoided (skipped bp-table fetches + skipped lane
+# re-uploads), overlap pairs that fell back to the host decode path
+# (CIGAR-needed subset + band rejects), bail-out count, and per-window
+# insertion-overflow attribution ("ins_overflow_windows").  All zeros
+# when RACON_TPU_RESIDENT is off.  Per-job reports filter to the
+# job's scope.
+SCHEMA_VERSION = 8
 
 KINDS = ("cli", "exec", "job")
 
@@ -80,6 +90,7 @@ _TOP = {
     "faults": (dict, True),             # fault class/site/lease counts
     "recovery": (dict, True),           # crash-safe serving counters
     "compiles": (dict, True),           # XLA compile attribution (v7)
+    "dataflow": (dict, True),           # resident-dataflow bytes (v8)
     "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
@@ -96,6 +107,9 @@ _RECOVERY_KEYS = ("recovered_jobs", "requeued_jobs",
                   "journal_compactions", "slot_restarts",
                   "slot_quarantined")
 _COMPILES_NUM_KEYS = ("total_s", "count", "post_warm", "sealed")
+_DATAFLOW_KEYS = ("resident", "bytes_fetched", "bytes_avoided",
+                  "fallback_pairs", "resident_bailouts",
+                  "lanes_device_groups", "ins_overflow_windows")
 _COMPILE_EVENT_STR_KEYS = ("fn", "signature", "phase")
 
 # per-shard row schema: key -> (accepted types, required)
@@ -186,6 +200,11 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         # phase) events from the process-wide jax.monitoring listener;
         # "post_warm" counts compiles after the serve warm-path seal
         "compiles": compilewatch.summary(scope),
+        # device-resident align→consensus accounting (round 19, schema
+        # v8): resident on/off, bytes fetched vs host round-trips
+        # avoided, host-fallback pair count and per-window insertion-
+        # overflow attribution — all zeros with the flag off
+        "dataflow": metrics.dataflow_summary(scope),
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and
         # the span-timer mirrors (dispatch/fetch per chip). {} on
@@ -259,6 +278,10 @@ def validate_report(rep) -> List[str]:
     for key in _PACK_KEYS:
         if not isinstance(rep["pack"].get(key), _NUM):
             errors.append(f"pack[{key!r}] missing or non-numeric")
+    for key in _DATAFLOW_KEYS:
+        if not isinstance(rep["dataflow"].get(key), _NUM) \
+                or isinstance(rep["dataflow"].get(key), bool):
+            errors.append(f"dataflow[{key!r}] missing or non-numeric")
     comp = rep["compiles"]
     for key in _COMPILES_NUM_KEYS:
         if not isinstance(comp.get(key), _NUM) \

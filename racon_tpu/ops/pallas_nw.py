@@ -892,7 +892,7 @@ def pallas_ok() -> bool:
                     jnp.asarray(ox), jnp.asarray(fix), jnp.asarray(fjx),
                     jnp.asarray(sx), args[2], args[3], qpw,
                     bg, max_len=max_len, band=band, L=L, K=K)
-                wx, ux, _ovx = _accumulate_votes(
+                wx, ux, _ovx, _owx = _accumulate_votes(
                     idxx, wx8, okx, win_of, args[3], bg, args[2],
                     jnp.asarray(sx), n_windows=nW, L=L, K=K, band=band)
                 idx, w8, fiv, fjv = pallas_walk_vote(
@@ -900,7 +900,7 @@ def pallas_ok() -> bool:
                     band=band, L=L, K=K, CH=CH, DEL=DEL)
                 okv = ((fiv == 0) & (fjv == 0)
                        & (jnp.asarray(sp) < (band // 2)))
-                wp, up, _ovp = _accumulate_votes(
+                wp, up, _ovp, _owp = _accumulate_votes(
                     idx, w8.astype(jnp.int32), okv, win_of, args[3], bg,
                     args[2], jnp.asarray(sp), n_windows=nW, L=L, K=K,
                     band=band)

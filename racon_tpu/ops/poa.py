@@ -385,7 +385,7 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
     time to keep the competition fair).
 
     Returns (weighted [n_windows, L*(1+K)*CH] f32, unweighted i32,
-    ins_overflow telemetry).
+    ins_overflow telemetry, per-window overflow counts [n_windows] i32).
     """
     B, S = idx.shape
     VOT = L * (1 + K) * CH
@@ -486,7 +486,8 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
         weighted = jnp.concatenate([w_cols, ins_w], axis=1)
         unweighted = jnp.concatenate(
             [c_cols.astype(jnp.int32), ins_c], axis=1)
-        return weighted, unweighted, jnp.int32(0)
+        return (weighted, unweighted, jnp.int32(0),
+                jnp.zeros((nW,), jnp.int32))
 
     # ---- insertion votes: two-level compaction, then one packed scatter
     # level 1 (per pair): an ok pair has < band//2 edits, hence < band//2
@@ -535,8 +536,15 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
         w2 = iw.reshape(rows, G * IC)
         (f2, w2), alive2 = _compact_rows(
             ialive.reshape(rows, G * IC), (f2, w2), G * IC)
-        ins_overflow = jnp.sum((alive2[:, cap:] & (w2[:, cap:] > 0)
-                                ).astype(jnp.int32))
+        # per-window overflow attribution (the r05 bare counter hid WHICH
+        # window's vote density tripped the uncapped-scatter fallback):
+        # an overflowing lane's flat address f2 still encodes its window
+        # as f2 // INS, so one tiny scatter tallies them per window
+        ovf_live = alive2[:, cap:] & (w2[:, cap:] > 0)
+        ins_ovf_w = jnp.zeros(nW + 1, jnp.int32).at[
+            jnp.where(ovf_live, f2[:, cap:] // INS, nW)].add(
+            ovf_live.astype(jnp.int32))[:nW]
+        ins_overflow = jnp.sum(ins_ovf_w)
         itab_w, itab_c = lax.cond(
             ins_overflow == 0,
             lambda: pack_scatter(
@@ -546,12 +554,13 @@ def _accumulate_votes(idx, w, ok, win_of, span_m, bg, n, score, *,
     else:  # tiny batches: skip the fold
         itab_w, itab_c = pack_scatter(iflat, iw)
         ins_overflow = jnp.int32(0)
+        ins_ovf_w = jnp.zeros((nW,), jnp.int32)
     ins_w = itab_w[:nW * INS].astype(jnp.float32).reshape(nW, INS)
     ins_c = itab_c[:nW * INS].astype(jnp.int32).reshape(nW, INS)
 
     weighted = jnp.concatenate([w_cols, ins_w], axis=1)
     unweighted = jnp.concatenate([c_cols.astype(jnp.int32), ins_c], axis=1)
-    return weighted, unweighted, ins_overflow
+    return weighted, unweighted, ins_overflow, ins_ovf_w
 
 
 @functools.partial(jax.jit, static_argnames=("L", "K"))
@@ -637,10 +646,11 @@ def refine_round(n, qpw, win_of, real, bg, ed,
     any round succeeded (false -> CPU fallback), ``frozen`` stop-refining
     flag (backbone outgrew Lb), ``conv`` converged flag (backbone
     reproduced itself; layers stop realigning). ``dropped`` accumulates telemetry
-    counters ([nd, 4] i32: rejected layer alignments, sweep-truncated
-    spans, fold-overflow insertion votes — which never lose votes, they
-    switch the round to the uncapped scatter — and executed post-gating
-    wavefront steps). The single source of truth for the round wiring,
+    counters ([nd, 4 + n_windows] i32: rejected layer alignments,
+    sweep-truncated spans, fold-overflow insertion votes — which never
+    lose votes, they switch the round to the uncapped scatter — executed
+    post-gating wavefront steps, then the fold overflows attributed to
+    their windows). The single source of truth for the round wiring,
     wrapped by :func:`refine_loop` (all rounds in one dispatch) and the
     ``shard_map`` path (``racon_tpu.parallel.sharded_refine_loop``).
 
@@ -708,7 +718,7 @@ def refine_round(n, qpw, win_of, real, bg, ed,
         idx, wv, okp = _vote_from_ops(
             ops, fi, fj, score, n, m, qpw[:, :Lq2],
             bg, max_len=Lq2, band=band, L=Lb, K=K)
-    weighted, unweighted, ins_ovf = _accumulate_votes(
+    weighted, unweighted, ins_ovf, ins_ovf_w = _accumulate_votes(
         idx, wv, okp, win_of, m, bg, n, score, n_windows=n_windows,
         L=Lb, K=K, band=band, scores=scores, matmul_votes=matmul_votes)
     winner, coverage, ins_winner, ins_emit, ins_cov = _consensus_kernel(
@@ -720,12 +730,15 @@ def refine_round(n, qpw, win_of, real, bg, ed,
     # [2] insertion votes past the fold-compaction cap (not lost — the
     # round fell back to the uncapped level-1 scatter), [3] executed
     # wavefront steps (sum of n+m AFTER convergence gating — the honest
-    # numerator for device-utilization estimates: gated pairs do no DP)
-    dropped = dropped + jnp.stack(
-        [jnp.sum((~okp) & real),
-         jnp.sum(real & (n + m > steps)),
-         ins_ovf,
-         jnp.sum(jnp.where(real, jnp.minimum(n + m, steps), 0))])[None, :]
+    # numerator for device-utilization estimates: gated pairs do no DP);
+    # columns [4:] attribute the fold overflows of [2] to their windows
+    dropped = dropped + jnp.concatenate(
+        [jnp.stack([jnp.sum((~okp) & real),
+                    jnp.sum(real & (n + m > steps)),
+                    ins_ovf,
+                    jnp.sum(jnp.where(real, jnp.minimum(n + m, steps),
+                                      0))]),
+         ins_ovf_w])[None, :]
 
     # ---- rebuild backbone rows from emitted columns/slots.
     # Entry order within a column: its base first, then insertion slots
@@ -842,6 +855,21 @@ def refine_loop(n, qpw, win_of, real, bg, ed,
     state = (bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
              dropped)
     return lax.while_loop(cond, body, (jnp.int32(0),) + state)[1:]
+
+
+@functools.partial(jax.jit, static_argnames=("Lq",))
+def _gather_qpw_rows(pool, src0, lens, *, Lq: int):
+    """Device-side twin of :meth:`LayerStore.gather_qpw` (round 19):
+    gather a group's packed ``weight << 3 | code`` lane block [B, Lq]
+    straight from the resident pool the align->consensus dataflow
+    uploaded once — the 2*B*Lq-byte per-group lane upload this replaces
+    is the ``lane_upload_saved_bytes`` accounting. Same clipped-index /
+    zero-pad construction, so the lanes are byte-identical to the host
+    gather."""
+    pos = jnp.arange(Lq, dtype=jnp.int32)[None, :]
+    idx = src0[:, None] + jnp.minimum(pos,
+                                      jnp.maximum(lens[:, None] - 1, 0))
+    return jnp.where(pos < lens[:, None], pool[idx], jnp.uint16(0))
 
 
 @jax.jit
@@ -1306,7 +1334,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
                       "ins_overflow": 0, "passthrough": 0,
                       "stage_b_windows": 0, "wavefront_steps": 0,
                       "lanes_occupied": 0, "lanes_total": 0,
-                      "groups": 0, "group_windows": 0}
+                      "groups": 0, "group_windows": 0,
+                      "lane_upload_saved_bytes": 0}
+        # per-window attribution of the ins_overflow counter (round 19,
+        # keyed by result index): the r05 bench showed a bare 265 with
+        # no way to tell WHICH window's insertion density tripped the
+        # uncapped-scatter fallback — kept out of ``stats`` so numeric
+        # consumers (bench JSON, stat-reset loops) stay untouched
+        self.ins_overflow_by_window: dict = {}
 
     # the floor keeps groups large enough that per-group fixed costs
     # (fetch round trips) stay amortized: 16x reduction is already a
@@ -1721,7 +1756,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                      jnp.zeros((nWp,), bool),
                      jnp.zeros((nWp,), bool),
                      jnp.zeros((nWp,), bool),
-                     jnp.zeros((1, 4), jnp.int32))
+                     jnp.zeros((1, 4 + nWp), jnp.int32))
             out = _refine_loop_packed(
                 *static, *state, jnp.float32(self.ins_theta),
                 jnp.float32(self.del_beta), rounds=rounds,
@@ -1729,7 +1764,16 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 K=K_INS, steps=steps, use_pallas=use_pallas,
                 use_swar=sw, Lq2=Lq2, scores=self.scores,
                 matmul_votes=self.use_matmul_votes)
+            # resident lane-ingest root, warmed with the SAME pow2 pool
+            # rule the uploader pads to (nw._pow2_pool) — a size
+            # mismatch costs one background compile of a tiny gather
+            from .nw import _pow2_pool
+            gat = _gather_qpw_rows(
+                jnp.zeros((_pow2_pool(Lq * B),), jnp.uint16),
+                jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.int32),
+                Lq=Lq)
             jax.block_until_ready(out[10])
+            jax.block_until_ready(gat)
 
         def _compile():
             try:
@@ -1786,7 +1830,8 @@ class TpuPoaConsensus(PallasDispatchMixin):
             self._run_stage_b_impl(survivors, trim, results, Lq, Lb,
                                    steps, Lq2, band)
 
-    def _pack_shard(self, items, Lq, B, nWp, Lb, overrides=None):
+    def _pack_shard(self, items, Lq, B, nWp, Lb, overrides=None,
+                    allow_dev=False):
         """Pack one shard's windows into fixed-shape pair/window arrays.
 
         ``items`` is a list of ``(result_index, _Work)``; pair rows beyond
@@ -1795,6 +1840,14 @@ class TpuPoaConsensus(PallasDispatchMixin):
         window's fetched stage-A state ``(bcodes_row, blen, covs_row,
         ever, bg_per_layer, ed_per_layer)`` so the window resumes from
         its refined backbone and remapped spans instead of restarting.
+
+        With ``allow_dev`` (single-shard, unpinned, meshless launches)
+        and every layer coming from ONE columnar store that carries a
+        device-resident pool (``store.dev_qpw``, uploaded by the
+        resident dataflow), the lane block is NOT host-gathered: the
+        third return value is ``(dev_pool, src0, lens)`` full-B gather
+        metadata for :func:`_gather_qpw_rows` and the host ``qpw`` stays
+        zeros. Otherwise the third return is None.
         """
         n = np.ones(B, np.int32)
         # packed layer lanes: weight << 3 | code per base (codes 3 bits,
@@ -1805,6 +1858,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         ed = np.zeros(B, np.int32)
         win_of = np.full(B, nWp - 1, np.int32)  # padding -> sink window
         real = np.zeros(B, bool)
+        dev_spec = None
 
         counts = np.array([w.n_layers for _, w in items], np.int64)
         k = int(counts.sum())
@@ -1840,7 +1894,18 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 rows = np.concatenate([items[wi][1].rows for wi in wis])
                 dest = np.concatenate(
                     [np.arange(offs[wi], offs[wi + 1]) for wi in wis])
-                qpw[dest] = store.gather_qpw(rows, Lq)
+                if (allow_dev and len(by_store) == 1 and not legacy
+                        and store.dev_qpw is not None):
+                    # resident dataflow: ship 8-byte gather rows, not
+                    # 2*Lq-byte lanes — the device reads the pool it
+                    # already holds
+                    src0_full = np.zeros(B, np.int32)
+                    lens_full = np.zeros(B, np.int32)
+                    src0_full[dest] = store.src[rows]
+                    lens_full[dest] = store.length[rows]
+                    dev_spec = (store.dev_qpw, src0_full, lens_full)
+                else:
+                    qpw[dest] = store.gather_qpw(rows, Lq)
 
             # hand-built windows (tests, benches): the round-7 join-and-
             # LUT path over just their layers
@@ -1905,7 +1970,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
                 off += kw
 
         return (n, qpw, win_of, real, bg, ed), \
-               (bcodes, bweights, blen, covs, ever)
+               (bcodes, bweights, blen, covs, ever), dev_spec
 
     def _launch_group_impl(self, live, Lq, Lb, overrides=None):
         """Pack one window group (per-mesh-shard when a mesh is set — pairs
@@ -1913,6 +1978,7 @@ class TpuPoaConsensus(PallasDispatchMixin):
         device-resident refinement state. ``overrides`` carries fetched
         stage-A state for a stage-B repack (see :meth:`_pack_shard`)."""
         from ..parallel import mesh_size, partition_balanced
+        # graftlint: disable=warmup-coverage (mesh size is fixed at engine construction; warm-up runs on the same engine so its shapes see the same nd)
         nd = mesh_size(self.mesh)
         if nd == 1:
             shards = [list(live)]
@@ -1927,7 +1993,12 @@ class TpuPoaConsensus(PallasDispatchMixin):
         B = self._pow2_at_least(max_pairs)
         nWp = self._pow2_at_least(max_wins + 1)
 
-        packs = [self._pack_shard(sh, Lq, B, nWp, Lb, overrides)
+        # device-lane ingest gate: one shard, no mesh, no per-chip pin
+        # (a pinned engine would gather across devices from the
+        # polisher-uploaded pool) — the parity grids cover both sides
+        allow_dev = nd == 1 and self.mesh is None and self.device is None
+        packs = [self._pack_shard(sh, Lq, B, nWp, Lb, overrides,
+                                  allow_dev=allow_dev)
                  for sh in shards]
         pair_np = [np.concatenate([p[0][a] for p in packs])
                    for a in range(6)]
@@ -1954,15 +2025,30 @@ class TpuPoaConsensus(PallasDispatchMixin):
         from ..parallel import to_global
         put = ((lambda a: to_global(self.mesh, a)) if self.mesh is not None
                else jnp.asarray)
-        static = tuple(put(a) for a in pair_np[:4])   # n, qpw, win_of, real
+        dev_spec = packs[0][2] if allow_dev else None
+        if dev_spec is not None:
+            # resident lane ingest: the pool is already on device, so the
+            # group's [B, Lq] uint16 lane block never crosses the link —
+            # only the 8-byte-per-pair gather rows do
+            pool_d, src0_full, lens_full = dev_spec
+            qpw_dev = _gather_qpw_rows(pool_d, jnp.asarray(src0_full),
+                                       jnp.asarray(lens_full), Lq=Lq)
+            saved = 2 * B * Lq
+            self.stats["lane_upload_saved_bytes"] += saved
+            metrics.inc("dataflow.bytes_avoided", saved)
+            metrics.inc("dataflow.lanes_device_groups")
+            static = (put(pair_np[0]), qpw_dev, put(pair_np[2]),
+                      put(pair_np[3]))
+        else:
+            static = tuple(put(a) for a in pair_np[:4])  # n qpw win_of real
         bg, ed = (put(pair_np[4]), put(pair_np[5]))
         bcodes, bweights, blen, covs, ever = (put(a) for a in win_np)
         zput = (lambda a: put(np.asarray(a)))
         frozen = zput(np.zeros(nd * nWp, bool))
         conv = zput(np.zeros(nd * nWp, bool))
         # telemetry row per shard: [dropped, sweep-truncated, ins-overflow,
-        # executed wavefront steps]
-        dropped = zput(np.zeros((nd, 4), np.int32))
+        # executed wavefront steps, then nWp per-window overflow tallies]
+        dropped = zput(np.zeros((nd, 4 + nWp), np.int32))
         state = [bg, ed, bcodes, bweights, blen, covs, ever, frozen, conv,
                  dropped]
         return {"shards": shards, "static": static, "state": state,
@@ -2166,15 +2252,15 @@ class TpuPoaConsensus(PallasDispatchMixin):
             return
         if "fetch2" in launch:
             nWr = launch["nd"] * nWp
-            nd4 = launch["nd"] * 4
+            ndt = launch["nd"] * (4 + nWp)
             B_all = launch["nd"] * launch["B"]
             bcodes = (mat & 7).astype(np.uint8)
             covs = mat >> 3
-            offs = np.cumsum([nWr, nWr, nWr, nWr, nd4, B_all])
+            offs = np.cumsum([nWr, nWr, nWr, nWr, ndt, B_all])
             blen, ever, frozen_h, conv_h, dropped, bg_h, ed_h = \
                 np.split(meta, offs)
             ever = ever.astype(bool)
-            dropped = dropped.reshape(launch["nd"], 4)
+            dropped = dropped.reshape(launch["nd"], 4 + nWp)
         else:
             bcodes, blen, covs, ever, dropped = fetched[:5]
             if collect is not None:
@@ -2211,11 +2297,19 @@ class TpuPoaConsensus(PallasDispatchMixin):
         metrics.inc("consensus.sweep_truncated", int(dropped[:, 1].sum()))
         metrics.inc("consensus.ins_overflow", int(dropped[:, 2].sum()))
         metrics.inc("consensus.wavefront_steps", int(dropped[:, 3].sum()))
+        # columns [4:] attribute the overflow counter to shard-local
+        # window rows (accumulated across this launch's rounds)
+        ovf_tail = dropped[:, 4:]
         B = launch["B"]
         for s, sh in enumerate(shards):
             off = 0  # pair-row offset within this shard's pack
             for wi, (i, w) in enumerate(sh):
                 row = s * nWp + wi
+                ovf = int(ovf_tail[s, wi])
+                if ovf:
+                    self.ins_overflow_by_window[i] = \
+                        self.ins_overflow_by_window.get(i, 0) + ovf
+                    metrics.inc("consensus.ins_overflow_windows")
                 kw = w.n_layers
                 p0 = s * B + off
                 off += kw

@@ -200,9 +200,11 @@ def sharded_refine_loop(mesh: Mesh, static, state, ins_theta, del_beta, *,
     ordinals.  ``state`` = (bg, ed, bcodes, bweights, blen, covs, ever,
     frozen, conv, dropped) — pair-major arrays share the pair stacking, window
     rows have leading dim ``n_shards * n_windows_local``, ``dropped`` is
-    a [n_shards, 4] telemetry row per shard (rejected alignments,
-    sweep-truncated spans, insertion-fold overflows, executed wavefront
-    steps).  Pairs belonging to one
+    a [n_shards, 4 + n_windows_local] telemetry row per shard (rejected
+    alignments, sweep-truncated spans, insertion-fold overflows,
+    executed wavefront steps, then the fold overflows attributed per
+    shard-local window row — the shard specs only constrain the leading
+    dim, so the widened trailing dim shards transparently).  Pairs belonging to one
     window must live in that window's shard — :func:`partition_balanced`
     plus per-shard packing guarantees it, so no cross-shard reduction is
     needed and the whole refinement loop scales collective-free.  Returns

@@ -126,7 +126,7 @@ def test_scoped_compile_s_preserved_and_serve_listener_absorbed():
 
 def test_report_v7_requires_compiles_section():
     rep = report.build_report("cli")
-    assert rep["schema_version"] == 7
+    assert rep["schema_version"] == report.SCHEMA_VERSION
     assert report.validate_report(rep) == []
     broken = dict(rep)
     del broken["compiles"]

@@ -119,9 +119,9 @@ def test_device_alpha_identity_at_defaults():
 
     args = [jnp.asarray(a) for a in (idx, w, ok, win_of, span_m,
                                      np.zeros(B, np.int32), n, score)]
-    w_def, u_def, _ = _accumulate_votes(
+    w_def, u_def, _, _ = _accumulate_votes(
         *args, n_windows=nW, L=L, K=K, band=64, scores=(3, -5, -4))
-    w_e2e, u_e2e, _ = _accumulate_votes(
+    w_e2e, u_e2e, _, _ = _accumulate_votes(
         *args, n_windows=nW, L=L, K=K, band=64, scores=(8, -6, -8))
     # defaults: every weight is w * 64 exactly
     assert float(w_def.max()) > 0

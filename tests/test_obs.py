@@ -277,6 +277,47 @@ def test_report_validate_rejects_corruption():
     assert any("phases" in e for e in report.validate_report(bad))
 
 
+def test_report_v8_requires_dataflow_section():
+    """Schema v8: the resident-dataflow accounting section is required,
+    fully populated (all keys numeric, zeros with the flag off), and
+    validated key-by-key."""
+    metrics.clear("dataflow.")
+    rep = report.build_report("cli")
+    assert report.validate_report(rep) == []
+    df = rep["dataflow"]
+    for key in ("resident", "bytes_fetched", "bytes_avoided",
+                "fallback_pairs", "resident_bailouts",
+                "lanes_device_groups", "ins_overflow_windows"):
+        assert df[key] == 0, (key, df)
+    broken = dict(rep)
+    del broken["dataflow"]
+    assert any("dataflow" in e for e in report.validate_report(broken))
+    bad = dict(rep, dataflow=dict(df, bytes_fetched="lots"))
+    assert any("bytes_fetched" in e for e in report.validate_report(bad))
+    bad = dict(rep, dataflow={k: v for k, v in df.items()
+                              if k != "resident"})
+    assert any("resident" in e for e in report.validate_report(bad))
+
+    # a resident run's numbers flow through (scoped, like a job report)
+    metrics.set_scope("job.df1.")
+    try:
+        metrics.set_gauge("dataflow.resident", 1)
+        metrics.inc("dataflow.bytes_fetched", 4096)
+        metrics.inc("dataflow.bytes_avoided", 1 << 20)
+        metrics.inc("dataflow.fallback_pairs", 3)
+        metrics.inc("consensus.ins_overflow_windows", 2)
+    finally:
+        metrics.set_scope(None)
+    scoped = report.build_report("job", scope="job.df1.")
+    assert report.validate_report(scoped) == []
+    assert scoped["dataflow"]["resident"] == 1
+    assert scoped["dataflow"]["bytes_fetched"] == 4096
+    assert scoped["dataflow"]["bytes_avoided"] == 1 << 20
+    assert scoped["dataflow"]["fallback_pairs"] == 3
+    assert scoped["dataflow"]["ins_overflow_windows"] == 2
+    metrics.clear("job.df1.")
+
+
 def test_report_shard_row_filters_manifest_keys():
     entry = {"id": 3, "status": "done", "part": "part_0003.fasta",
              "contigs": [1, 2], "engine": "primary", "mbp": 1.25,

@@ -323,7 +323,7 @@ def test_insertion_accumulator_deep_window_regression():
         args = [jnp.asarray(a) for a in
                 (idx, w, ok, win_of, span_m, np.zeros(B, np.int32), n,
                  score)]
-        weighted, unweighted, ovf = _accumulate_votes(
+        weighted, unweighted, ovf, _ = _accumulate_votes(
             *args, n_windows=nW, L=L, K=K, band=band)
         # alpha == 64 at default scores: every vote lands as 9 * 64
         assert float(np.asarray(weighted)[0, addr]) == B * 9 * 64
@@ -381,7 +381,7 @@ def test_matmul_votes_deep_address_regression():
     args = [jnp.asarray(a) for a in
             (idx, w, ok, win_of, span_m, np.zeros(B, np.int32), n,
              score)]
-    weighted, unweighted, ovf = _accumulate_votes(
+    weighted, unweighted, ovf, _ = _accumulate_votes(
         *args, n_windows=nW, L=L, K=K, band=band, matmul_votes=True)
     expect = np.int64(B) * 90 * 64
     assert expect > (1 << 24)         # past the old f32 exactness bound
@@ -391,7 +391,7 @@ def test_matmul_votes_deep_address_regression():
     assert int(ovf) == 0
     # the unweighted counts (exact ints on both paths) must agree with
     # the scatter/f32 reference emitter bit-for-bit
-    _, unw_ref, _ = _accumulate_votes(
+    _, unw_ref, _, _ = _accumulate_votes(
         *args, n_windows=nW, L=L, K=K, band=band, matmul_votes=False)
     assert np.array_equal(np.asarray(unweighted), np.asarray(unw_ref))
 

@@ -1,0 +1,259 @@
+"""Device-resident align→consensus dataflow parity (round 19).
+
+With ``RACON_TPU_RESIDENT=1`` the accepted breaking-point tables stay on
+device, window assignment and per-window layer rows derive via jit'd
+array ops (``ops/nw._derive_layer_rows``), and the consensus engine
+gathers its ``weight<<3|code`` lanes from the device-resident pool
+(``ops/poa._gather_qpw_rows``).  The contract is BYTE-PARITY with the
+host path — the host ``Polisher._filter_layer_rows`` oracle — not
+approximation.  This suite drives the real create_polisher surface with
+the device backends across the shapes that stress the filters (mixed
+strands, dummy-quality FASTA reads, F-mode multi-overlap inputs, the
+chunked pipelined emit), asserts the resident path actually ENGAGED
+(``dataflow.resident`` gauge; a silently-disengaged path would pass
+parity trivially), and pins the bail-out ladder: every precondition
+failure must fall back to the host path with identical output.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.obs import metrics
+
+from test_columnar_init import polished_bytes, write_synthetic_assembly
+
+
+def _fastq_to_fasta(fastq_path, fasta_path):
+    """Strip qualities: the dummy-quality (FASTA-reads) leg."""
+    with open(fastq_path, "rb") as f:
+        lines = f.read().split(b"\n")
+    with open(fasta_path, "wb") as f:
+        for i in range(0, len(lines) - 3, 4):
+            f.write(b">" + lines[i][1:] + b"\n" + lines[i + 1] + b"\n")
+    return fasta_path
+
+
+def _device_engines():
+    """Single-device engines (mesh=None): the conftest 8-virtual-device
+    mesh would gate off the ragged align stream — the only
+    resident-capable dispatch path — and the device-lane consensus
+    ingest, exactly as a production mesh run would."""
+    from racon_tpu.core.backends import NativeAligner, NativePoaConsensus
+    from racon_tpu.ops.nw import TpuAligner
+    from racon_tpu.ops.poa import TpuPoaConsensus
+
+    return (TpuAligner(fallback=NativeAligner(2), mesh=None),
+            TpuPoaConsensus(3, -5, -4,
+                            fallback=NativePoaConsensus(3, -5, -4, 2),
+                            mesh=None))
+
+
+def _run_leg(reads, paf, layout, *, resident, type_=PolisherType.C,
+             num_threads=1, quality_threshold=10.0):
+    """One polishing run through single-device engines; returns
+    (polished bytes, timings, dataflow summary)."""
+    metrics.clear_run()
+    if resident:
+        os.environ["RACON_TPU_RESIDENT"] = "1"
+    try:
+        aligner, consensus = _device_engines()
+        p = create_polisher(
+            str(reads), str(paf), str(layout), type_=type_,
+            quality_threshold=quality_threshold,
+            num_threads=num_threads,
+            aligner_backend="tpu", consensus_backend="tpu",
+            aligner=aligner, consensus=consensus)
+        out = polished_bytes(p.run(True))
+    finally:
+        os.environ.pop("RACON_TPU_RESIDENT", None)
+    return out, dict(p.timings), metrics.dataflow_summary()
+
+
+def _assert_engaged(timings, dataflow):
+    """The resident leg must have actually run on device — a leg that
+    silently fell back to host would make every parity assert vacuous."""
+    assert dataflow["resident"] == 1, dataflow
+    assert dataflow["bytes_fetched"] > 0, dataflow
+    assert dataflow["bytes_avoided"] > 0, dataflow
+    assert "window_derive_s" in timings, timings
+
+
+@pytest.mark.parametrize("seed,n_contigs,threads", [
+    (23, 2, 1),    # mixed strands, sequential (monolithic assembly)
+    (31, 2, 4),    # mixed strands, pipelined chunked emit
+    (47, 1, 1),    # single contig
+])
+def test_resident_matches_host_e2e(tmp_path, seed, n_contigs, threads):
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=seed,
+                                          n_contigs=n_contigs)
+    want, host_tm, host_df = _run_leg(rp, pp, lp, resident=False,
+                                      num_threads=threads)
+    assert host_df["resident"] == 0, host_df
+    got, tm, df = _run_leg(rp, pp, lp, resident=True,
+                           num_threads=threads)
+    _assert_engaged(tm, df)
+    assert got == want
+
+
+def test_resident_dummy_quality_fasta_reads(tmp_path):
+    """FASTA reads (quality None): the PHRED gate must not fire on
+    device either — has_q lanes are False, min-span still filters."""
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=29)
+    fa = _fastq_to_fasta(rp, tmp_path / "reads.fasta")
+    want, _, _ = _run_leg(fa, pp, lp, resident=False)
+    got, tm, df = _run_leg(fa, pp, lp, resident=True)
+    _assert_engaged(tm, df)
+    assert got == want
+
+
+def test_resident_f_mode_multi_overlap(tmp_path):
+    """F-mode keeps every overlap per query (no best-per-group rule):
+    the multi-overlap-per-read shape through the device derive."""
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=37)
+    want, _, _ = _run_leg(rp, pp, lp, resident=False,
+                          type_=PolisherType.F)
+    got, tm, df = _run_leg(rp, pp, lp, resident=True,
+                           type_=PolisherType.F)
+    _assert_engaged(tm, df)
+    assert got == want
+
+
+def test_resident_high_quality_threshold_filters_on_device(tmp_path):
+    """A threshold that actually rejects rows (the b'9'=24 qualities
+    fail a 30.0 mean-PHRED gate) must reject the SAME rows on device —
+    the integer-inequality form of the filter is exercised, not just
+    the everything-passes case."""
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=41, n_contigs=1)
+    want, _, _ = _run_leg(rp, pp, lp, resident=False,
+                          quality_threshold=30.0)
+    got, tm, df = _run_leg(rp, pp, lp, resident=True,
+                           quality_threshold=30.0)
+    _assert_engaged(tm, df)
+    assert got == want
+
+
+def test_resident_bails_on_fractional_quality_threshold(tmp_path):
+    """The device mean-PHRED gate is exact only for integer thresholds:
+    a fractional one must BAIL to the host path (resident gauge 0,
+    bailout counted) and still produce byte-identical output."""
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=43, n_contigs=1)
+    want, _, _ = _run_leg(rp, pp, lp, resident=False,
+                          quality_threshold=10.5)
+    got, tm, df = _run_leg(rp, pp, lp, resident=True,
+                           quality_threshold=10.5)
+    assert df["resident"] == 0, df
+    assert df["resident_bailouts"] >= 1, df
+    assert "window_derive_s" not in tm, tm
+    assert got == want
+
+
+def test_resident_off_publishes_zero_dataflow(tmp_path):
+    """With the flag off, the dataflow ledger stays all-zero (the run
+    report's v8 section is meaningful, not noise)."""
+    rp, pp, lp = write_synthetic_assembly(tmp_path, seed=53, n_contigs=1)
+    _, tm, df = _run_leg(rp, pp, lp, resident=False)
+    assert df["resident"] == 0 and df["bytes_fetched"] == 0, df
+    assert df["lanes_device_groups"] == 0, df
+    assert "window_derive_s" not in tm, tm
+
+
+def test_resident_unit_derive_matches_host_oracle():
+    """Unit-level grid over the jit'd row-derive kernel vs an
+    independent numpy re-statement of the host oracle's arithmetic:
+    min-span boundary spans (0..3 around s_min=2), integer mean-PHRED
+    boundaries, empty-layer rows, dead lanes and past-n_reg slots —
+    the exactness proofs, pinned."""
+    import jax.numpy as jnp
+
+    from racon_tpu.ops.nw import (_ROW_SENTINEL, _derive_layer_rows,
+                                  _pow2_pool)
+
+    rng = np.random.default_rng(7)
+    wl = 100
+    B, NW, Lq = 16, 8, 256
+    s_min = int(np.ceil(0.02 * wl))  # = 2
+    q_need = 10
+
+    pool_len = _pow2_pool(Lq * B)
+    qpw = np.zeros(pool_len, np.uint16)
+    # weights (high 13 bits of weight<<3|code) clustered around q_need
+    # so the cross-multiplied PHRED gate lands on both sides, including
+    # exact-equality sums
+    qpw[:Lq * B] = (rng.integers(q_need - 2, q_need + 3,
+                                 Lq * B).astype(np.uint16) << 3) \
+        | rng.integers(0, 8, Lq * B).astype(np.uint16)
+    weights = (qpw >> 3).astype(np.int64)
+
+    tb = rng.integers(0, 64, B).astype(np.int32)
+    qo_read = rng.integers(0, 32, B).astype(np.int32)
+    qo_pool = (np.arange(B, dtype=np.int32) * Lq)
+    n_reg = rng.integers(2, NW, B).astype(np.int32)
+    live = rng.random(B) < 0.9
+    has_q = rng.random(B) < 0.7
+    qlen = np.full(B, Lq, np.int32)
+    win_base = rng.integers(0, 1000, B).astype(np.int32)
+    ov_idx = np.arange(B, dtype=np.int32)
+
+    # packed tpos<<14|qpos slot tables (positions relative to the
+    # overlap: tb/qo_read are added by the kernel), monotone per lane
+    BIG = 1 << 30
+    bp_first = np.full((B, NW), BIG, np.int32)
+    bp_last = np.full((B, NW), BIG, np.int32)
+    ref = np.zeros((B, NW, 4), np.int64)  # t_first, qf, t_endx, qe
+    for b in range(B):
+        t = int(rng.integers(0, wl // 2))
+        q = 0
+        for k in range(NW):
+            span = int(rng.integers(0, 4))      # brackets s_min = 2
+            t_span = int(rng.integers(1, wl))
+            t_last = t + t_span - 1
+            q_last = min(q + max(span - 1, 0), Lq - 2)
+            bp_first[b, k] = (t << 14) | q
+            bp_last[b, k] = (t_last << 14) | q_last
+            ref[b, k] = (tb[b] + t, q, tb[b] + t_last + 1, q_last + 1)
+            t = t_last + 1
+            q = q_last + int(rng.integers(0, 2))
+
+    rows = np.asarray(_derive_layer_rows(
+        jnp.asarray(bp_first), jnp.asarray(bp_last), jnp.asarray(qpw),
+        jnp.asarray(live), jnp.asarray(tb), jnp.asarray(qo_read),
+        jnp.asarray(qo_pool), jnp.asarray(n_reg),
+        jnp.asarray(win_base), jnp.asarray(ov_idx),
+        jnp.asarray(has_q), jnp.asarray(qlen),
+        np.int32(s_min), np.int32(q_need), w=wl, NW=NW, Lq=Lq))
+    assert rows.shape == (B * NW, 6)
+
+    csum = np.zeros(pool_len + 1, np.int64)
+    np.cumsum(weights, out=csum[1:])
+    checked_kept = checked_dropped = 0
+    for b in range(B):
+        for k in range(NW):
+            row = rows[b * NW + k]
+            if not live[b] or k > n_reg[b]:
+                assert row[0] == _ROW_SENTINEL, (b, k, row)
+                continue
+            t_first, qf, t_endx, qe = ref[b, k]
+            span = qe - qf
+            keep = span >= s_min
+            if keep and has_q[b]:
+                lo = qo_pool[b] + qf
+                keep = (csum[lo + span] - csum[lo]) >= q_need * span
+            rank = t_first // wl
+            lb = t_first - rank * wl
+            le = t_endx - rank * wl - 1
+            keep = keep and lb != le
+            if not keep:
+                assert row[0] == _ROW_SENTINEL, (b, k, row)
+                checked_dropped += 1
+            else:
+                assert row[0] == win_base[b] + rank, (b, k, row)
+                assert row[1] == ov_idx[b]
+                assert row[2] == qo_read[b] + qf
+                assert row[3] == qo_read[b] + qe
+                assert row[4] == lb and row[5] == le
+                checked_kept += 1
+    # the grid must exercise both outcomes or the parity claim is hollow
+    assert checked_kept > 0 and checked_dropped > 0

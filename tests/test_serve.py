@@ -599,11 +599,11 @@ def test_serve_sanitized_warm_path_assert_fires_only_when_unwarmed(
                 assert h2["ok"], h2
                 assert h2["compiles_after_warm"] == 0
                 assert p2 == p1
-                # the schema-v7 job report carries the attribution
+                # the versioned job report carries the attribution
                 # section, clean for the repeat-shape job
                 rep2 = h2["report"]
                 assert report.validate_report(rep2) == []
-                assert rep2["schema_version"] == 7
+                assert rep2["schema_version"] == report.SCHEMA_VERSION
                 assert rep2["compiles"]["post_warm"] == 0
                 assert rep2["compiles"]["sealed"] == 1
 

@@ -1,0 +1,34 @@
+"""Seeded host-transfer-in-jit violations on the fused resident
+align->consensus dataflow shape (expect 3): a jit'd row-derive root
+that round-trips its packed breaking-point table through numpy
+mid-derive, and a lane-gather helper reached with a traced pool from a
+second jit root — exactly the mid-pipeline transfers the resident
+dataflow exists to eliminate."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("nw",))
+def derive_rows(bp_first, bp_last, *, nw):
+    span = (bp_last & 0x3FFF) - (bp_first & 0x3FFF) + 1
+    # BAD: np reduction of the traced span table — host transfer in
+    # the middle of the fused derive
+    widest = np.max(span)
+    # BAD: np.asarray on the traced packed table (numpy __array__
+    # concretizes one batch's breaking points into the program)
+    host_rows = np.asarray(bp_first >> 14)
+    return span + widest + host_rows[0] + nw
+
+
+def gather_lanes(pool, rows):
+    # BAD: reached with traced (pool, rows) from consensus_root — the
+    # lane gather must stay on device, not bounce through numpy
+    return np.take(pool, rows)
+
+
+@jax.jit
+def consensus_root(pool, rows):
+    return gather_lanes(pool * 1, jnp.clip(rows, 0, pool.shape[0] - 1))

@@ -53,6 +53,11 @@ EXPECT = {
     "warmup_coverage_ok.py": ("warmup-coverage", 0, 1),
     "host_transfer_bad.py": ("host-transfer-in-jit", 3, 0),
     "host_transfer_ok.py": ("host-transfer-in-jit", 0, 1),
+    # round 19: the fused resident align->consensus dataflow shape —
+    # mid-derive numpy round-trips on the jit'd row-derive/lane-gather
+    # roots are exactly the transfers the resident path eliminates
+    "resident_dataflow_bad.py": ("host-transfer-in-jit", 3, 0),
+    "resident_dataflow_ok.py": ("host-transfer-in-jit", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }

@@ -194,7 +194,7 @@ def main():
             n_windows=nWp, L=Lb, K=K_INS, band=band))
         t_scatter = timeit_pipelined(lambda: sc(idx, w8, okp, win_of))
         print(f"accum:     {t_scatter * 1e3:8.2f} ms", flush=True)
-        weighted, unweighted, _ = sc(idx, w8, okp, win_of)
+        weighted, unweighted, _, _ = sc(idx, w8, okp, win_of)
     else:
         from racon_tpu.ops.nw import _nw_wavefront_kernel, _walk_ops_kernel
         fwd = lambda: _nw_wavefront_kernel(qrp, tp, n_, m_, max_len=Lq,
@@ -210,9 +210,9 @@ def main():
             idx, wv, okp = _vote_from_ops(
                 ops, fi, fj, score, n_, m_, qcodes, qweights, bg,
                 max_len=Lq, band=band, L=Lb, K=K_INS)
-            w_, u_, _ = _accumulate_votes(idx, wv, okp, win_of, m_, bg,
-                                          n_, score, n_windows=nWp,
-                                          L=Lb, K=K_INS, band=band)
+            w_, u_, _, _ = _accumulate_votes(idx, wv, okp, win_of, m_, bg,
+                                             n_, score, n_windows=nWp,
+                                             L=Lb, K=K_INS, band=band)
             return w_, u_, okp
         weighted, unweighted, okp = jax.block_until_ready(vt())
         t_scatter = timeit_pipelined(vt)
