@@ -671,6 +671,122 @@ def bench_pipeline():
     }
 
 
+def bench_overlap():
+    """First-party overlapper benchmark (round 20): seed+match+chain a
+    RACON_TPU_BENCH_OVERLAP-Mbp (default 1) simulated assembly through
+    ``--overlaps auto``'s own path and report overlapper Mbp/s plus the
+    seed/chain lane occupancies and the candidate-pair funnel. Quality
+    gate: an auto-fed polish leg must land within noise of the
+    PAF-fed leg's edit distance to truth (and far below the draft's),
+    and the emitted auto PAF must be byte-identical across reruns.
+    0 disables."""
+    import os
+    import sys
+    import tempfile
+    import time as _time
+
+    from racon_tpu import flags as racon_flags
+
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_OVERLAP")
+    if not mbp:
+        return {}
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from simulate import simulate
+    from racon_tpu import native
+    from racon_tpu.core.polisher import create_polisher
+    from racon_tpu.exec.index import write_auto_paf
+    from racon_tpu.obs import metrics as obs_metrics
+    from racon_tpu.obs import trace as obs_trace
+
+    log(f"overlap bench: {mbp} Mbp first-party overlapper...")
+    reads, paf, contigs, truths = simulate(mbp, seed=37)
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        rp = os.path.join(td, "reads.fastq")
+        pp = os.path.join(td, "ovl.paf")
+        cp = os.path.join(td, "draft.fasta")
+        for path, blob in ((rp, reads), (pp, paf), (cp, contigs)):
+            with open(path, "wb") as f:
+                f.write(blob)
+
+        # ---- overlapper-only throughput leg (parse -> seed -> match
+        # -> chain -> PAF serialize, the sharded auto path verbatim)
+        obs_metrics.clear_run()
+        obs_trace.activate(tracing=False)
+        t0 = _time.perf_counter()
+        write_auto_paf(rp, cp, os.path.join(td, "auto1.paf"))
+        dt = _time.perf_counter() - t0
+        g = obs_metrics.group("overlap.")
+        in_mbp = (sum(len(s) for s in reads.split(b"\n")[1::4])
+                  + sum(len(t) for t in truths)) / 1e6
+        seed_occ = (g.get("seed_lanes_occupied", 0)
+                    / max(1, g.get("seed_lanes_total", 1)))
+        chain_occ = (g.get("chain_lanes_occupied", 0)
+                     / max(1, g.get("chain_lanes_total", 1)))
+        log(f"overlapper: {in_mbp:.2f} Mbp in {dt:.2f}s = "
+            f"{in_mbp / dt:.3f} Mbp/s; {g.get('minimizers', 0)} "
+            f"minimizers, {g.get('candidate_pairs', 0)} candidate "
+            f"pairs, {g.get('chains_kept', 0)} chains kept "
+            f"({g.get('chains_dropped', 0)} dropped, "
+            f"{g.get('freq_capped_buckets', 0)} hot buckets capped); "
+            f"occupancy seed {seed_occ:.3f} chain {chain_occ:.3f}")
+        # rerun byte-identity (the acceptance determinism contract)
+        write_auto_paf(rp, cp, os.path.join(td, "auto2.paf"))
+        with open(os.path.join(td, "auto1.paf"), "rb") as f1, \
+                open(os.path.join(td, "auto2.paf"), "rb") as f2:
+            b1, b2 = f1.read(), f2.read()
+        assert b1 == b2, "auto PAF not byte-identical across reruns"
+        assert len(b1) > 0, "auto overlapper emitted no overlaps"
+
+        # ---- auto-vs-PAF polish legs (same quality probe as
+        # bench_pipeline: bounded truth-prefix Myers distance)
+        def polish_leg(ovl):
+            obs_metrics.clear_run()
+            obs_trace.activate(tracing=False)
+            t0 = _time.perf_counter()
+            p = create_polisher(rp, ovl, cp, num_threads=8)
+            polished = p.run(drop_unpolished_sequences=True)
+            leg_s = _time.perf_counter() - t0
+            probe = min(100_000, len(truths[0]))
+            pol0 = next((s.data for s in polished
+                         if s.name.startswith(b"contig_0")), b"")
+            return (native.edit_distance(pol0[:probe], truths[0][:probe]),
+                    leg_s, probe)
+
+        err_auto, auto_s, probe = polish_leg("auto")
+        err_paf, paf_s, _ = polish_leg(pp)
+        draft0 = contigs.split(b"\n", 1)[1].split(b"\n", 1)[0]
+        err_before = native.edit_distance(draft0[:probe],
+                                          truths[0][:probe])
+        log(f"polish quality (err/{probe // 1000}k to truth): draft "
+            f"{err_before} -> PAF-fed {err_paf} vs auto-fed {err_auto} "
+            f"(auto leg {auto_s:.1f}s, PAF leg {paf_s:.1f}s)")
+        assert err_auto < 0.2 * err_before, \
+            "auto-fed polish did not substantially improve the draft"
+        assert err_auto <= err_paf * 1.3 + 20, \
+            "auto-fed polish quality outside noise of the PAF-fed leg"
+
+        out = {
+            "overlap_mbp": round(in_mbp, 3),
+            "overlap_mbp_per_sec": round(in_mbp / dt, 4),
+            "overlap_minimizers": int(g.get("minimizers", 0)),
+            "overlap_candidate_pairs": int(g.get("candidate_pairs", 0)),
+            "overlap_chains_kept": int(g.get("chains_kept", 0)),
+            "overlap_chains_dropped": int(g.get("chains_dropped", 0)),
+            "overlap_freq_capped": int(g.get("freq_capped_buckets", 0)),
+            "overlap_seed_occupancy": round(seed_occ, 4),
+            "overlap_chain_occupancy": round(chain_occ, 4),
+            "overlap_rerun_identical": True,
+            "overlap_err_per_100k_before": err_before,
+            "overlap_err_per_100k_paf": err_paf,
+            "overlap_err_per_100k_auto": err_auto,
+            "overlap_auto_leg_s": round(auto_s, 2),
+            "overlap_paf_leg_s": round(paf_s, 2),
+        }
+    return out
+
+
 def bench_shards():
     """Streaming shard-runner scaling entry (the ROADMAP ">=100 Mbp
     demonstration"): run a RACON_TPU_BENCH_SHARDS-sized (default 100)
@@ -1172,6 +1288,7 @@ def main():
     aligner_metrics = bench_aligner()
     scale_metrics = bench_scale()
     pipeline_metrics = bench_pipeline()
+    overlap_metrics = bench_overlap()
     shard_metrics = bench_shards()
     multichip_metrics = bench_multichip()
     service_metrics = bench_service()
@@ -1192,6 +1309,7 @@ def main():
         **aligner_metrics,
         **scale_metrics,  # scale_mbp_per_sec + pack occupancy + A/B grid
         **pipeline_metrics,  # full-pipeline Mbp/s + CPU baseline
+        **overlap_metrics,  # first-party overlapper Mbp/s + quality A/B
         **shard_metrics,  # streaming shard-runner scaling curve
         **multichip_metrics,  # Mbp/s-vs-chips curve + identity assert
         **service_metrics,  # resident-service p50/p95 + compile fraction

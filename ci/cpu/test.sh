@@ -38,6 +38,14 @@ RACON_TPU_SANITIZE=1 RACON_TPU_SANITIZE_SAMPLE=1 \
 # decoder, and the pipelined run() — including the num_threads=1
 # sequential-fallback smoke — before anything slow runs
 python -m pytest tests/test_columnar_init.py tests/test_window.py -q
+# first-party overlapper shard (fail-fast, round 20; the consolidated
+# graftlint gate above covers racon_tpu/ops/overlap_seed.py +
+# chain.py): minimizer/chain kernel-vs-numpy-oracle parity, the slice-
+# boundary dedup, resident-fetch parity, freq-cap accounting, the
+# warm-up cache claim, and the --overlaps auto determinism contract —
+# byte-identical across thread counts, --shards 2, and gz/FASTQ/FASTA
+# input variants — plus the planner/rampler no-overlaps-file cases
+python -m pytest tests/test_overlapper.py -q
 # ragged-packing shard (fail-fast, round 10): the {padded,ragged} x
 # {scatter,matmul} byte-identity grid — and the same grid again under
 # the runtime sanitizer, so the int32 shadow path proves itself on the
@@ -130,7 +138,7 @@ python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_resident_dataflow.py \
   --ignore=tests/test_serve.py --ignore=tests/test_serve_recovery.py \
   --ignore=tests/test_topology.py --ignore=tests/test_parallel.py \
-  --ignore=tests/test_compile_surface.py
+  --ignore=tests/test_compile_surface.py --ignore=tests/test_overlapper.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

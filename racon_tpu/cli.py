@@ -32,7 +32,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "sequences used for correction")
     p.add_argument("overlaps", nargs="?", default=None,
                    help="MHAP/PAF/SAM file (may be gzipped) with "
-                        "overlaps between sequences and targets")
+                        "overlaps between sequences and targets, or the "
+                        "literal 'auto' to compute overlaps in-process "
+                        "with the first-party minimizer-chain overlapper "
+                        "(no external mapper needed; see also "
+                        "RACON_TPU_OVERLAP*)")
     p.add_argument("target_sequences", nargs="?", default=None,
                    help="FASTA/FASTQ file (may be "
                         "gzipped) with targets to correct")

@@ -105,10 +105,12 @@ def create_polisher(sequences_path: str, overlaps_path: str, target_path: str,
             raise ValueError(
                 f"file {path} has unsupported format extension (valid: "
                 f"{', '.join(parsers.SEQUENCE_EXTENSIONS)})")
-    if parsers.overlap_parser_for(overlaps_path) is None:
+    if parsers.overlaps_mode(overlaps_path) != "auto" \
+            and parsers.overlap_parser_for(overlaps_path) is None:
         raise ValueError(
             f"file {overlaps_path} has unsupported format extension (valid: "
-            f"{', '.join(parsers.OVERLAP_EXTENSIONS)})")
+            f"{', '.join(parsers.OVERLAP_EXTENSIONS)}, or the literal "
+            f"'auto' for the first-party overlapper)")
     return Polisher(sequences_path, overlaps_path, target_path, type_,
                     window_length, quality_threshold, error_threshold, trim,
                     match, mismatch, gap, num_threads, aligner_backend,
@@ -261,14 +263,18 @@ class Polisher:
         log.log("[racon_tpu::Polisher::initialize] loaded sequences")
         log.log()
 
-        with obs.span("parse.overlaps"):
-            oparse = parsers.overlap_parser_for(self.overlaps_path)
-            overlaps: List[Overlap] = []
-            for rec in oparse(self.overlaps_path):
-                o = Overlap.from_record(rec)
-                o.transmute(self.sequences, name_to_id, id_to_id)
-                if o.is_valid:
-                    overlaps.append(o)
+        if parsers.overlaps_mode(self.overlaps_path) == "auto":
+            overlaps = self._generate_overlaps(raw_index, name_to_id,
+                                               id_to_id)
+        else:
+            with obs.span("parse.overlaps"):
+                oparse = parsers.overlap_parser_for(self.overlaps_path)
+                overlaps = []
+                for rec in oparse(self.overlaps_path):
+                    o = Overlap.from_record(rec)
+                    o.transmute(self.sequences, name_to_id, id_to_id)
+                    if o.is_valid:
+                        overlaps.append(o)
 
         with obs.span("overlap.filter"):
             if not self.prefiltered_overlaps:
@@ -345,6 +351,50 @@ class Polisher:
         # meaningful only for run(): layer-assembly wall hidden under the
         # consensus engine (the split surface overlaps nothing)
         self.timings.setdefault("pipeline_overlap_saved_s", 0.0)
+        return overlaps
+
+    def _generate_overlaps(self, raw_index: int,
+                           name_to_id: Dict[bytes, int],
+                           id_to_id: Dict[int, int]) -> List[Overlap]:
+        """``--overlaps auto``: run the first-party overlapper
+        (:mod:`racon_tpu.ops.overlap_seed` + :mod:`racon_tpu.ops.chain`)
+        over the already-loaded pools and emit transmuted ``Overlap``
+        rows — downstream (filter, breaking points, windows) is exactly
+        the PAF path over the same rows."""
+        from ..ops import chain as chain_ops
+        from ..ops import overlap_seed
+        metrics.set_gauge("overlap.mode_auto", 1)
+        read_pos = [id_to_id[i << 1] for i in range(raw_index)]
+        read_seqs = [self.sequences[p].data for p in read_pos]
+        target_seqs = [self.sequences[i].data
+                       for i in range(self.targets_size)]
+        read_self_t = np.fromiter(
+            (p if p < self.targets_size else -1 for p in read_pos),
+            np.int64, raw_index)
+        k = max(4, min(16, flags.get_int("RACON_TPU_OVERLAP_K")))
+        if flags.get_bool("RACON_TPU_WARMUP"):
+            # race the chain-arena compile against host seeding/matching
+            est_len = max((len(s) for s in read_seqs), default=0)
+            overlap_seed.warmup_async(est_len, len(read_seqs))
+            chain_ops.warmup_async(max(1, est_len // 8), raw_index, k=k)
+        # graftlint: disable=jit-shape-hazard (k is a run-constant flag value clipped to 4..16 — one compile per run)
+        rows = chain_ops.find_overlaps(read_seqs, target_seqs,
+                                       read_self_t, k=k)
+        overlaps: List[Overlap] = []
+        for i in range(rows["q_ord"].size):
+            q = int(rows["q_ord"][i])
+            t = int(rows["t_idx"][i])
+            o = Overlap.from_paf(
+                self.sequences[read_pos[q]].name, len(read_seqs[q]),
+                int(rows["q_begin"][i]), int(rows["q_end"][i]),
+                "-" if int(rows["strand"][i]) else "+",
+                self.sequences[t].name, len(target_seqs[t]),
+                int(rows["t_begin"][i]), int(rows["t_end"][i]))
+            o.transmute(self.sequences, name_to_id, id_to_id)
+            if o.is_valid:
+                overlaps.append(o)
+        self.logger.log("[racon_tpu::Polisher::initialize] generated "
+                        "overlaps (first-party overlapper)")
         return overlaps
 
     def _filter_overlaps(self, overlaps: List[Overlap]) -> List[Overlap]:

@@ -69,6 +69,10 @@ class RunIndex:
     ov_end: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
     ov_target: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
     ov_read: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int64))
+    # reads-only planning (--overlaps auto before the overlapper ran):
+    # total read bases to apportion across contigs by contig size when
+    # no per-contig overlap groups exist yet
+    uniform_read_bases: int = 0
     _groups: Optional[dict] = field(default=None, repr=False, compare=False)
 
     def __post_init__(self):
@@ -109,6 +113,13 @@ class RunIndex:
         shared by two contigs is charged to both — shard costs are an
         upper bound, recomputed on the union after packing)."""
         out = np.zeros(len(self.targets), np.int64)
+        if self.uniform_read_bases and not self.ov_read.size:
+            # no overlaps indexed yet (--overlaps auto planning): charge
+            # read bases to contigs proportionally to contig size
+            tb = np.fromiter((t.bases for t in self.targets), np.int64,
+                             len(self.targets))
+            total = max(1, int(tb.sum()))
+            return tb * self.uniform_read_bases // total
         for t, g in self._contig_groups().items():
             out[t] = int(self.read_spans[np.unique(self.ov_read[g]),
                                          2].sum())
@@ -280,3 +291,96 @@ def _global_filter(lines, type_: PolisherType,
     flush(group)
     kept.sort(key=lambda l: l.start)  # back to file order across groups
     return kept
+
+
+# ------------------------------------------- first-party overlapper mode
+
+def write_auto_paf(sequences_path: str, target_path: str,
+                   paf_path: str) -> None:
+    """``--overlaps auto`` for shard runs: run the first-party
+    overlapper (:mod:`racon_tpu.ops.chain`) over the inputs and write
+    its rows as a 12-column PAF — deterministic bytes, atomically
+    replaced, so reruns and concurrent workers converge on the same
+    file and the resume fingerprint (path + size) stays stable."""
+    from ..ops import chain as chain_ops
+    tparse = parsers.sequence_parser_for(target_path)
+    sparse = parsers.sequence_parser_for(sequences_path)
+    if tparse is None or sparse is None:
+        raise ValueError("unsupported sequence format extension")
+    target_names: List[bytes] = []
+    target_seqs: List[bytes] = []
+    for rec in tparse(target_path):
+        target_names.append(rec.name)
+        target_seqs.append(rec.data)
+    target_ids = {n: i for i, n in enumerate(target_names)}
+    read_names: List[bytes] = []
+    read_seqs: List[bytes] = []
+    for rec in sparse(sequences_path):
+        read_names.append(rec.name)
+        read_seqs.append(rec.data)
+    read_self_t = np.fromiter(
+        (target_ids.get(n, -1) for n in read_names), np.int64,
+        len(read_names))
+    rows = chain_ops.find_overlaps(read_seqs, target_seqs, read_self_t)
+    from .. import flags
+    k = max(4, min(16, flags.get_int("RACON_TPU_OVERLAP_K")))
+    lines = chain_ops.paf_bytes(
+        rows, read_names,
+        np.fromiter((len(s) for s in read_seqs), np.int64,
+                    len(read_seqs)),
+        target_names,
+        np.fromiter((len(s) for s in target_seqs), np.int64,
+                    len(target_seqs)), k=k)
+    from .manifest import atomic_write
+    atomic_write(paf_path, b"".join(lines))
+
+
+def build_index_auto(sequences_path: str, target_path: str,
+                     paf_path: str, type_: PolisherType = PolisherType.C,
+                     error_threshold: float = 0.3) -> RunIndex:
+    """``--overlaps auto`` index: materialize the overlapper's rows as
+    a deterministic PAF in the work dir, then index THAT file with the
+    ordinary :func:`build_index` — the global-filter replay and every
+    byte-span consumer (shard extraction, resume fingerprints) see a
+    real overlaps file, so shard-count invariance needs no new path."""
+    import os
+    if not os.path.isfile(paf_path):
+        write_auto_paf(sequences_path, target_path, paf_path)
+    return build_index(sequences_path, paf_path, target_path, type_,
+                       error_threshold)
+
+
+def build_index_readsonly(sequences_path: str,
+                          target_path: str) -> RunIndex:
+    """Metadata-only index for planning an ``--overlaps auto`` run
+    before the overlapper has produced anything: targets + read spans
+    with :attr:`RunIndex.uniform_read_bases` set, so the planner's cost
+    model works from reads + target sizes alone."""
+    tscan = parsers.scan_sequence_spans(target_path)
+    if tscan is None:
+        raise ValueError(f"file {target_path} has unsupported format "
+                         f"extension")
+    targets = list(tscan)
+    if not targets:
+        raise ValueError("empty target sequences set")
+    rscan = parsers.scan_sequence_spans(sequences_path)
+    if rscan is None:
+        raise ValueError(f"file {sequences_path} has unsupported format "
+                         f"extension")
+    read_names: List[bytes] = []
+    spans: List[Tuple[int, int, int]] = []
+    total_len = 0
+    for rec in rscan:
+        read_names.append(rec.name)
+        spans.append((rec.start, rec.end, rec.bases))
+        total_len += rec.bases
+    if not read_names:
+        raise ValueError("empty sequences set")
+    read_spans = np.asarray(spans, np.int64).reshape(-1, 3)
+    window_type = (WindowType.NGS
+                   if total_len / len(read_names) <= 1000
+                   else WindowType.TGS)
+    idx = RunIndex(sequences_path, parsers.AUTO_OVERLAPS, target_path,
+                   "paf", targets, read_spans, read_names, window_type)
+    idx.uniform_read_bases = total_len
+    return idx

@@ -82,10 +82,17 @@ def estimate_job_cost(sequences: str, overlaps: str,
     per contig, collapsed to whole files for the resident service's
     admission control (``racon_tpu.serve``): same weights, same
     deliberate over-estimation (reject one job too many rather than
-    OOM one job too few)."""
-    return (2 * input_cost_bytes(target_sequences)
-            + 3 * input_cost_bytes(sequences)
-            + 2 * input_cost_bytes(overlaps))
+    OOM one job too few).
+
+    ``--overlaps auto`` jobs have no overlaps file; their overlap rows
+    live in memory at roughly read-pool scale, so the estimate charges
+    the reads term once more instead of an overlaps-file term."""
+    from ..io import parsers
+    base = (2 * input_cost_bytes(target_sequences)
+            + 3 * input_cost_bytes(sequences))
+    if parsers.is_auto_overlaps(overlaps):
+        return base + input_cost_bytes(sequences)
+    return base + 2 * input_cost_bytes(overlaps)
 
 
 def parse_ram(text: str) -> int:

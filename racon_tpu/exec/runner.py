@@ -74,7 +74,7 @@ from ..utils.logger import warn
 from . import heartbeat as hb
 from . import lease as lease_mod
 from . import manifest as mf
-from .index import RunIndex, build_index
+from .index import RunIndex, build_index, build_index_auto
 from .planner import (MESH_DEVICE, ShardPlan, assign_devices,
                       plan_shards)
 
@@ -220,7 +220,12 @@ class ShardRunner:
                  merge: bool = True, secondary: bool = False,
                  defer_cleanup: bool = False, chips: int = 0):
         self.sequences = os.path.abspath(sequences)
-        self.overlaps = os.path.abspath(overlaps)
+        # --overlaps auto: normalize to the sentinel (there is no file
+        # to abspath); run() materializes the overlapper's PAF into the
+        # work dir and repoints self.overlaps at it before indexing
+        self.overlaps = (parsers.AUTO_OVERLAPS
+                         if parsers.overlaps_mode(overlaps) == "auto"
+                         else os.path.abspath(overlaps))
         self.target_sequences = os.path.abspath(target_sequences)
         self.type = type_
         self.window_length = window_length
@@ -405,13 +410,29 @@ class ShardRunner:
         # schema-valid zeros
         metrics.clear_run()
         obs.trace.activate()
-        _eprint(f"indexing {os.path.basename(self.overlaps)} / "
-                f"{os.path.basename(self.sequences)} "
-                f"(worker {self.worker})")
-        with obs.span("exec.index"):
-            self.index = build_index(self.sequences, self.overlaps,
-                                     self.target_sequences, self.type,
-                                     self.error_threshold)
+        if parsers.is_auto_overlaps(self.overlaps):
+            # first-party overlapper: materialize a deterministic PAF
+            # in the work dir (reused on resume — same bytes, so the
+            # path+size resume fingerprint holds) and index that file;
+            # every downstream byte-span consumer works unchanged
+            os.makedirs(self.work_dir, exist_ok=True)
+            auto_paf = os.path.join(self.work_dir, "auto_overlaps.paf")
+            _eprint(f"overlapping {os.path.basename(self.sequences)} "
+                    f"(first-party overlapper, worker {self.worker})")
+            with obs.span("exec.index"):
+                self.index = build_index_auto(
+                    self.sequences, self.target_sequences, auto_paf,
+                    self.type, self.error_threshold)
+            self.overlaps = auto_paf
+        else:
+            _eprint(f"indexing {os.path.basename(self.overlaps)} / "
+                    f"{os.path.basename(self.sequences)} "
+                    f"(worker {self.worker})")
+            with obs.span("exec.index"):
+                self.index = build_index(self.sequences, self.overlaps,
+                                         self.target_sequences,
+                                         self.type,
+                                         self.error_threshold)
         base_rss = hb.peak_rss_bytes()
         with obs.span("exec.plan"):
             self.plan = plan_shards(self.index, self.n_shards,

@@ -236,6 +236,28 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Base of the client reconnect exponential backoff (doubled "
          "per attempt, deterministic CRC32 jitter added — the shared "
          "faults.backoff_s formula the exec ladder uses)."),
+    # ------------------------------------------------ first-party overlapper
+    Flag("RACON_TPU_OVERLAP", "", "str",
+         "Overlap source override: 'auto' runs the first-party "
+         "minimizer-seed + chain overlapper in-process regardless of "
+         "the overlaps CLI argument; 'paf' (or unset) follows the "
+         "positional argument, which itself accepts the literal "
+         "sentinel 'auto'."),
+    Flag("RACON_TPU_OVERLAP_K", "15", "int",
+         "Overlapper minimizer k-mer length (4..16; canonical codes "
+         "live in uint32)."),
+    Flag("RACON_TPU_OVERLAP_W", "5", "int",
+         "Overlapper minimizer window: each run of w consecutive "
+         "k-mers contributes its leftmost minimum-hash k-mer."),
+    Flag("RACON_TPU_OVERLAP_MAX_OCC", "64", "int",
+         "Overlapper seed frequency cap: hash buckets whose total "
+         "occurrence count (reads + targets) exceeds this drop whole "
+         "before matching (counted in the run report's overlap "
+         "section, never silent)."),
+    Flag("RACON_TPU_OVERLAP_MIN_SEEDS", "4", "int",
+         "Minimum chained seeds for an overlapper candidate pair to "
+         "emit an overlap row (pairs and chains below it count as "
+         "chains_dropped)."),
     # -------------------------------------------------------- tests, bench
     Flag("RACON_TPU_SLOW", "0", "bool",
          "Enable the slow (tier-2) test set."),
@@ -274,6 +296,12 @@ REGISTRY: Dict[str, Flag] = _declare([
          "How many sequential job submissions the resident-service "
          "bench drives through one server (the acceptance metric's "
          "sample size)."),
+    Flag("RACON_TPU_BENCH_OVERLAP", "1", "float",
+         "bench.py first-party overlapper workload size in Mbp: "
+         "overlapper Mbp/s with seed/chain occupancy, plus an "
+         "--overlaps auto vs minimap2-style-PAF-fed polish A/B "
+         "asserting edit distance to truth within noise and auto-mode "
+         "rerun byte-identity (0 disables)."),
 ])
 
 

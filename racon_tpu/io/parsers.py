@@ -28,6 +28,28 @@ SEQUENCE_EXTENSIONS = (
 FASTQ_EXTENSIONS = (".fastq", ".fastq.gz", ".fq", ".fq.gz")
 OVERLAP_EXTENSIONS = (".mhap", ".mhap.gz", ".paf", ".paf.gz", ".sam", ".sam.gz")
 
+# the overlaps-path sentinel selecting the first-party in-process
+# overlapper (racon_tpu/ops/overlap_seed.py + chain.py) instead of a
+# precomputed PAF/MHAP/SAM file
+AUTO_OVERLAPS = "auto"
+
+
+def is_auto_overlaps(path: str) -> bool:
+    """True when ``path`` is the ``--overlaps auto`` sentinel (no
+    overlaps file exists; the overlapper generates rows in memory)."""
+    return path == AUTO_OVERLAPS
+
+
+def overlaps_mode(path: str) -> str:
+    """The effective overlap source for an overlaps argument: ``auto``
+    when the sentinel is given or ``RACON_TPU_OVERLAP=auto`` overrides
+    a file path, else ``paf`` (precomputed-file mode)."""
+    if is_auto_overlaps(path):
+        return "auto"
+    from .. import flags
+    forced = flags.get_str("RACON_TPU_OVERLAP").strip().lower()
+    return "auto" if forced == "auto" else "paf"
+
 
 class ParseError(ValueError):
     """A malformed input record, carrying structured location info:

@@ -287,6 +287,42 @@ def dataflow_summary(scope: str = "") -> Dict[str, Number]:
         }
 
 
+def overlap_summary(scope: str = "") -> Dict[str, Number]:
+    """The first-party overlapper accounting the run report's
+    ``overlap`` section (schema v9) embeds: the overlap source
+    (``auto`` when the in-process minimizer+chain overlapper generated
+    the rows — the ``overlap.mode_auto`` gauge — else ``paf`` for
+    precomputed-file runs, where every other key is legitimately
+    zero), table/candidate volume, the frequency-cap and chain
+    keep/drop accounting (capped buckets are counted, never silent),
+    and the seed/chain dispatch-vs-fetch split from the obs span
+    timers.  ``scope`` reads one job's numbers."""
+    with _lock:
+        return {
+            "mode": ("auto"
+                     if _gauges.get(scope + "overlap.mode_auto", 0)
+                     else "paf"),
+            "minimizers": _counters.get(
+                scope + "overlap.minimizers", 0),
+            "candidate_pairs": _counters.get(
+                scope + "overlap.candidate_pairs", 0),
+            "freq_capped_buckets": _counters.get(
+                scope + "overlap.freq_capped_buckets", 0),
+            "chains_kept": _counters.get(
+                scope + "overlap.chains_kept", 0),
+            "chains_dropped": _counters.get(
+                scope + "overlap.chains_dropped", 0),
+            "seed_dispatch_s": round(_timers.get(
+                scope + "overlap.seed.dispatch", 0.0), 3),
+            "seed_fetch_s": round(_timers.get(
+                scope + "overlap.seed.fetch", 0.0), 3),
+            "chain_dispatch_s": round(_timers.get(
+                scope + "overlap.chain.dispatch", 0.0), 3),
+            "chain_fetch_s": round(_timers.get(
+                scope + "overlap.chain.fetch", 0.0), 3),
+        }
+
+
 def recovery_summary() -> Dict[str, Number]:
     """The crash-safe-serving counters the run report's ``recovery``
     section (schema v5) embeds: journal replay/append/compaction

@@ -68,7 +68,14 @@ from . import compilewatch, metrics
 # insertion-overflow attribution ("ins_overflow_windows").  All zeros
 # when RACON_TPU_RESIDENT is off.  Per-job reports filter to the
 # job's scope.
-SCHEMA_VERSION = 8
+# v9 (round 20): the "overlap" section became required — first-party
+# overlapper accounting (``overlap.*`` metrics): the overlap source
+# ("mode": "auto" for the in-process minimizer+chain overlapper, "paf"
+# for precomputed-file runs where the numbers are legitimately zero),
+# minimizer-table and candidate-pair volume, frequency-capped bucket
+# and chain keep/drop counts, and the seed/chain dispatch-vs-fetch
+# seconds from the ``overlap.seed.*``/``overlap.chain.*`` span timers.
+SCHEMA_VERSION = 9
 
 KINDS = ("cli", "exec", "job")
 
@@ -91,6 +98,7 @@ _TOP = {
     "recovery": (dict, True),           # crash-safe serving counters
     "compiles": (dict, True),           # XLA compile attribution (v7)
     "dataflow": (dict, True),           # resident-dataflow bytes (v8)
+    "overlap": (dict, True),            # first-party overlapper (v9)
     "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
@@ -110,6 +118,12 @@ _COMPILES_NUM_KEYS = ("total_s", "count", "post_warm", "sealed")
 _DATAFLOW_KEYS = ("resident", "bytes_fetched", "bytes_avoided",
                   "fallback_pairs", "resident_bailouts",
                   "lanes_device_groups", "ins_overflow_windows")
+_OVERLAP_NUM_KEYS = ("minimizers", "candidate_pairs",
+                     "freq_capped_buckets", "chains_kept",
+                     "chains_dropped", "seed_dispatch_s",
+                     "seed_fetch_s", "chain_dispatch_s",
+                     "chain_fetch_s")
+_OVERLAP_MODES = ("auto", "paf")
 _COMPILE_EVENT_STR_KEYS = ("fn", "signature", "phase")
 
 # per-shard row schema: key -> (accepted types, required)
@@ -205,6 +219,11 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         # avoided, host-fallback pair count and per-window insertion-
         # overflow attribution — all zeros with the flag off
         "dataflow": metrics.dataflow_summary(scope),
+        # first-party overlapper accounting (round 20, schema v9):
+        # overlap source, table/candidate volume, freq-cap and chain
+        # keep/drop counts, seed/chain dispatch-vs-fetch seconds —
+        # mode "paf" with zeros for precomputed-overlap runs
+        "overlap": metrics.overlap_summary(scope),
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and
         # the span-timer mirrors (dispatch/fetch per chip). {} on
@@ -282,6 +301,13 @@ def validate_report(rep) -> List[str]:
         if not isinstance(rep["dataflow"].get(key), _NUM) \
                 or isinstance(rep["dataflow"].get(key), bool):
             errors.append(f"dataflow[{key!r}] missing or non-numeric")
+    if rep["overlap"].get("mode") not in _OVERLAP_MODES:
+        errors.append(f"overlap['mode'] {rep['overlap'].get('mode')!r} "
+                      f"not in {_OVERLAP_MODES}")
+    for key in _OVERLAP_NUM_KEYS:
+        if not isinstance(rep["overlap"].get(key), _NUM) \
+                or isinstance(rep["overlap"].get(key), bool):
+            errors.append(f"overlap[{key!r}] missing or non-numeric")
     comp = rep["compiles"]
     for key in _COMPILES_NUM_KEYS:
         if not isinstance(comp.get(key), _NUM) \

@@ -11,6 +11,13 @@
 - ``racon_tpu.ops.swar`` — SWAR packed-lane primitives (int16x2 score
   lanes, 2-bit bases), the bit-exact availability probe and the int16
   overflow guard shared by both DP kernel families.
+- ``racon_tpu.ops.overlap_seed`` — strand-canonical minimizer seeding
+  for the first-party overlapper (``--overlaps auto``): batched
+  windowed-minimum kernel over 2-bit codes with a device compaction
+  path (role of minimap2's sketch pass).
+- ``racon_tpu.ops.chain`` — seed matching + banded integer chain DP
+  emitting ``Overlap`` rows (role of minimap2's chaining), the fourth
+  kernel family next to NW and POA.
 """
 
 import os as _os

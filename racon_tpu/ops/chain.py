@@ -1,0 +1,472 @@
+"""Seed matching + banded chaining DP — stage two of the first-party
+overlapper (``--overlaps auto``, ROADMAP item 5).
+
+Consumes the flat minimizer tables from :mod:`.overlap_seed` and emits
+``Overlap``-compatible rows:
+
+- **matching** runs on host numpy: both tables sort by hash, repeat-
+  induced super-buckets over the occurrence cap drop whole (counted in
+  ``overlap.freq_capped_buckets`` — never silent), the sorted
+  intersection expands into hits via the standard ragged ramp, self
+  hits (a read matching the target it *is*) drop, and a lexsort groups
+  hits into candidate pairs ``(read, target, relative strand)`` with
+  per-pair seed lists sorted by target position. Sorting a few million
+  uint32 keys is cheap next to alignment and keeps this path exactly
+  deterministic.
+- **chaining** is the device DP: pairs ragged-pack by pow2 seed-count
+  bucket into fixed ``[B, S]`` arenas (the ``_AlignStream`` discipline,
+  warmed via :func:`_warmup_shapes`), and a ``lax.scan`` over seed
+  positions scores gap-bounded colinear chains against a bounded
+  lookback window, then backtracks on device so only a ``[B, 6]``
+  summary per launch crosses the link — resident-friendly by
+  construction.
+
+Scoring is all-integer (seed span minus a gap penalty in 1/16-base
+units), so the kernel and the numpy oracle :func:`chain_np` agree
+bit-for-bit and byte-identical reruns fall out for free. Reverse-strand
+query coordinates flip to ``q' = qlen - pos - k`` before chaining (so
+colinearity means ascending in both axes) and flip back on emission.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import obs
+from ..obs import metrics
+from ..parallel import fetch_global
+from . import overlap_seed
+
+# chain DP shape/score constants (module-level: one compile surface)
+CHAIN_LOOKBACK = 16       # bounded predecessor window H
+MAX_GAP = 10_000          # max per-axis seed gap inside one chain
+BAND_DIAG = 512           # max |dq - dt| diagonal drift
+GAP_UNIT = 16             # score scale: 1 matched base = GAP_UNIT,
+                          # 1 gap base costs 1 (i.e. 1/16 of a match)
+_NEG = -(1 << 30)         # masked-lane score sentinel
+# chain-arena budget in cells (ts/qs operands and the scan history all
+# scale with B*S)
+CHAIN_ARENA_CELLS = 1 << 21
+DEFAULT_MAX_OCC = 64
+DEFAULT_MIN_SEEDS = 4
+
+
+# -------------------------------------------------------------- geometry
+
+def _seed_bucket(n: int) -> int:
+    """pow2 seed-list bucket for one candidate pair (floor 16) — the
+    quantizer both dispatch and :func:`_warmup_shapes` derive the
+    arena's S axis from."""
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pair_batch(S: int, n: int) -> int:
+    """pow2 pair-batch cap for one chain launch against the fixed
+    :data:`CHAIN_ARENA_CELLS` arena (companion of :func:`_seed_bucket`;
+    shared with warm-up)."""
+    want = min(max(1, n), max(1, CHAIN_ARENA_CELLS // max(1, S)))
+    b = 1
+    while b < want:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------- kernel
+
+@functools.partial(jax.jit, static_argnames=("S", "k"))
+def _chain_kernel(ts, qs, ns, *, S: int, k: int):
+    """Gap-scored colinear chaining over a ``[B, S]`` packed seed arena.
+
+    ``ts``/``qs`` are per-pair seed coordinates sorted by ``(t, q)``,
+    ``ns`` the live seed count per lane. A scan over seed index scores
+    each seed against the :data:`CHAIN_LOOKBACK` previous seeds
+    (integer scoring, deterministic nearest-predecessor tie-break),
+    then a second scan backtracks the best chain on device. Returns
+    ``[B, 6]`` int32 rows ``(score, n_chained, q_lo, q_hi, t_lo,
+    t_hi)`` — the only fetch."""
+    B = ts.shape[0]
+    H = CHAIN_LOOKBACK
+    ts_t = ts.T.astype(jnp.int32)       # [S, B]
+    qs_t = qs.T.astype(jnp.int32)
+    start = jnp.int32(k * GAP_UNIT)
+
+    def score_step(carry, xs):
+        ht, hq, hf = carry              # [B, H] histories, newest first
+        tc, qc, i = xs
+        live = i < ns
+        dt = tc[:, None] - ht
+        dq = qc[:, None] - hq
+        gap = jnp.abs(dq - dt)
+        ok = ((dt >= 1) & (dq >= 1) & (dt <= MAX_GAP) & (dq <= MAX_GAP)
+              & (gap <= BAND_DIAG) & (hf > jnp.int32(_NEG // 2)))
+        span = jnp.minimum(jnp.int32(k), jnp.minimum(dq, dt))
+        cand = jnp.where(ok, hf + span * GAP_UNIT - gap, jnp.int32(_NEG))
+        best = jnp.max(cand, axis=1)
+        arg = jnp.argmax(cand, axis=1).astype(jnp.int32)  # nearest wins ties
+        f_i = jnp.where(live, jnp.maximum(start, best), jnp.int32(_NEG))
+        parent = jnp.where(live & (best > start), arg + 1, jnp.int32(0))
+        ht = jnp.concatenate([tc[:, None], ht[:, :-1]], axis=1)
+        hq = jnp.concatenate([qc[:, None], hq[:, :-1]], axis=1)
+        hf = jnp.concatenate([f_i[:, None], hf[:, :-1]], axis=1)
+        return (ht, hq, hf), (f_i, parent)
+
+    init = (jnp.zeros((B, H), jnp.int32), jnp.zeros((B, H), jnp.int32),
+            jnp.full((B, H), _NEG, jnp.int32))
+    idx = jnp.arange(S, dtype=jnp.int32)
+    _, (f_all, p_all) = lax.scan(score_step, init, (ts_t, qs_t, idx))
+    f = f_all.T                          # [B, S]
+    parent = p_all.T                     # [B, S] offsets 0..H
+    lanes = jnp.arange(B, dtype=jnp.int32)
+    end = jnp.argmax(f, axis=1).astype(jnp.int32)  # ties -> lowest index
+    score = f[lanes, end]
+    live0 = ns > 0
+
+    def back_step(carry, _):
+        cur, active, n, q_lo, t_lo = carry
+        q_lo = jnp.where(active, qs[lanes, cur], q_lo)
+        t_lo = jnp.where(active, ts[lanes, cur], t_lo)
+        n = n + active.astype(jnp.int32)
+        off = parent[lanes, cur]
+        nxt_active = active & (off > 0)
+        cur = jnp.where(nxt_active, cur - off, cur)
+        return (cur, nxt_active, n, q_lo, t_lo), None
+
+    binit = (end, live0, jnp.zeros(B, jnp.int32),
+             jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32))
+    (cur, _, n_chained, q_lo, t_lo), _ = lax.scan(
+        back_step, binit, None, length=S)
+    q_hi = qs[lanes, end]
+    t_hi = ts[lanes, end]
+    out = jnp.stack([jnp.where(live0, score, jnp.int32(_NEG)), n_chained,
+                     q_lo, q_hi, t_lo, t_hi], axis=1)
+    return out
+
+
+# -------------------------------------------------------- host matching
+
+def match_seeds(read_table, target_table, read_self_t: np.ndarray,
+                qlens: np.ndarray, *, k: int, max_occ: int
+                ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Sorted-hash intersection of the two minimizer tables.
+
+    Returns ``(hits, freq_capped)`` where ``hits`` holds per-hit
+    parallel arrays — ``q`` (read ordinal), ``t`` (target index),
+    ``rel`` (relative strand), ``tp`` (target seed pos), ``qc`` (query
+    seed pos, already flipped for reverse-strand hits) — lexsorted by
+    ``(q, t, rel, tp, qc)`` so candidate pairs are consecutive runs.
+    Buckets whose total occurrence count (both tables) exceeds
+    ``max_occ`` drop whole; ``freq_capped`` counts them."""
+    rh, rid, rpos, rstr = read_table
+    th, tid, tpos, tstr = target_table
+    empty = {key: np.zeros(0, np.int64) for key in
+             ("q", "t", "rel", "tp", "qc")}
+    if rh.size == 0 or th.size == 0:
+        return empty, 0
+
+    ro = np.argsort(rh, kind="stable")
+    rh, rid, rpos, rstr = rh[ro], rid[ro], rpos[ro], rstr[ro]
+    to = np.argsort(th, kind="stable")
+    th, tid, tpos, tstr = th[to], tid[to], tpos[to], tstr[to]
+
+    uh, uc = np.unique(np.concatenate([rh, th]), return_counts=True)
+    hot = uc > max_occ
+    freq_capped = int(hot.sum())
+    keep_r = ~hot[np.searchsorted(uh, rh)]
+    keep_t = ~hot[np.searchsorted(uh, th)]
+    rh, rid, rpos, rstr = rh[keep_r], rid[keep_r], rpos[keep_r], rstr[keep_r]
+    th, tid, tpos, tstr = th[keep_t], tid[keep_t], tpos[keep_t], tstr[keep_t]
+    if rh.size == 0 or th.size == 0:
+        return empty, freq_capped
+
+    lo = np.searchsorted(th, rh, "left")
+    hi = np.searchsorted(th, rh, "right")
+    cnt = (hi - lo).astype(np.int64)
+    total = int(cnt.sum())
+    if total == 0:
+        return empty, freq_capped
+    ridx = np.repeat(np.arange(rh.size, dtype=np.int64), cnt)
+    ramp = np.arange(total, dtype=np.int64) - np.repeat(
+        np.cumsum(cnt) - cnt, cnt)
+    tidx = np.repeat(lo.astype(np.int64), cnt) + ramp
+
+    q = rid[ridx].astype(np.int64)
+    t = tid[tidx].astype(np.int64)
+    rel = (rstr[ridx] != tstr[tidx]).astype(np.int64)
+    tp = tpos[tidx].astype(np.int64)
+    qp = rpos[ridx].astype(np.int64)
+    notself = t != read_self_t[q]
+    q, t, rel, tp, qp = (q[notself], t[notself], rel[notself],
+                         tp[notself], qp[notself])
+    qc = np.where(rel == 1, qlens[q] - qp - k, qp)
+    order = np.lexsort((qc, tp, rel, t, q))
+    return ({"q": q[order], "t": t[order], "rel": rel[order],
+             "tp": tp[order], "qc": qc[order]}, freq_capped)
+
+
+# ---------------------------------------------------------- numpy oracle
+
+def chain_np(ts: np.ndarray, qs: np.ndarray, k: int
+             ) -> Tuple[int, int, int, int, int, int]:
+    """Pure-python/numpy chain oracle with exactly the kernel's
+    semantics: integer scoring, bounded lookback, nearest-predecessor
+    strict-> tie-break, lowest-index best-end tie-break. Returns
+    ``(score, n_chained, q_lo, q_hi, t_lo, t_hi)``."""
+    n = len(ts)
+    if n == 0:
+        return (_NEG, 0, 0, 0, 0, 0)
+    start = k * GAP_UNIT
+    f = [0] * n
+    par = [0] * n
+    for i in range(n):
+        best, arg = _NEG, -1
+        for off in range(1, CHAIN_LOOKBACK + 1):  # nearest first
+            j = i - off
+            if j < 0:
+                break
+            dt, dq = ts[i] - ts[j], qs[i] - qs[j]
+            gap = abs(dq - dt)
+            if dt < 1 or dq < 1 or dt > MAX_GAP or dq > MAX_GAP \
+                    or gap > BAND_DIAG:
+                continue
+            cand = f[j] + min(k, dq, dt) * GAP_UNIT - gap
+            if cand > best:  # strict: ties keep the nearer predecessor
+                best, arg = cand, off
+        f[i] = max(start, best)
+        par[i] = arg if best > start else 0
+    end = int(np.argmax(np.asarray(f)))
+    cur, cnt = end, 0
+    while True:
+        cnt += 1
+        if par[cur] == 0:
+            break
+        cur -= par[cur]
+    return (f[end], cnt, int(qs[cur]), int(qs[end]),
+            int(ts[cur]), int(ts[end]))
+
+
+# -------------------------------------------------------------- chaining
+
+def chain_pairs(hits: Dict[str, np.ndarray], *, k: int, min_seeds: int
+                ) -> Tuple[Dict[str, np.ndarray], int, int]:
+    """Run the chain DP over every candidate pair in ``hits``.
+
+    Returns ``(chains, kept, dropped)``: parallel arrays ``q``, ``t``,
+    ``rel``, ``score``, ``n_seeds``, ``q_lo``, ``q_hi``, ``t_lo``,
+    ``t_hi`` (query coords still in chain space — flipped for reverse
+    hits), one row per pair whose best chain holds ``min_seeds``+
+    seeds. Pairs with fewer matched seeds than ``min_seeds`` drop
+    before the DP; both drop classes count into ``dropped``."""
+    empty = {key: np.zeros(0, np.int64) for key in
+             ("q", "t", "rel", "score", "n_seeds",
+              "q_lo", "q_hi", "t_lo", "t_hi")}
+    nhits = hits["q"].size
+    if nhits == 0:
+        return empty, 0, 0
+    key_change = np.zeros(nhits, bool)
+    key_change[0] = True
+    for col in ("q", "t", "rel"):
+        key_change[1:] |= hits[col][1:] != hits[col][:-1]
+    starts = np.flatnonzero(key_change)
+    ends = np.append(starts[1:], nhits)
+    counts = ends - starts
+    metrics.inc("overlap.candidate_pairs", int(starts.size))
+
+    eligible = counts >= min_seeds
+    dropped = int((~eligible).sum())
+    starts, ends, counts = starts[eligible], ends[eligible], counts[eligible]
+    if starts.size == 0:
+        return empty, 0, dropped
+
+    by_bucket: Dict[int, List[int]] = {}
+    for i, c in enumerate(counts):
+        by_bucket.setdefault(_seed_bucket(int(c)), []).append(i)
+
+    rows_out = np.zeros((starts.size, 6), np.int64)
+    for S in sorted(by_bucket):
+        members = by_bucket[S]
+        cap = _pair_batch(S, len(members))
+        for begin in range(0, len(members), cap):
+            part = members[begin:begin + cap]
+            B = _pair_batch(S, len(part))
+            ts = np.zeros((B, S), np.int32)
+            qs = np.zeros((B, S), np.int32)
+            ns = np.zeros(B, np.int32)
+            for lane, m in enumerate(part):
+                c = int(counts[m])
+                ts[lane, :c] = hits["tp"][starts[m]:ends[m]]
+                qs[lane, :c] = hits["qc"][starts[m]:ends[m]]
+                ns[lane] = c
+            with obs.span("overlap.chain.dispatch", pairs=len(part)):
+                # graftlint: disable=jit-shape-hazard (k is a run-constant flag value — one compile per run; S is the pow2 bucket)
+                out = _chain_kernel(ts, qs, ns, S=S, k=k)
+            with obs.span("overlap.chain.fetch", pairs=len(part)):
+                out_np = fetch_global([out])[0]
+            rows_out[part] = out_np[:len(part)].astype(np.int64)
+            metrics.inc("overlap.chain_lanes_total", B * S)
+            metrics.inc("overlap.chain_lanes_occupied", int(ns.sum()))
+
+    good = rows_out[:, 1] >= min_seeds
+    kept = int(good.sum())
+    dropped += int((~good).sum())
+    sel = np.flatnonzero(good)
+    first = starts[sel]
+    return ({"q": hits["q"][first], "t": hits["t"][first],
+             "rel": hits["rel"][first],
+             "score": rows_out[sel, 0], "n_seeds": rows_out[sel, 1],
+             "q_lo": rows_out[sel, 2], "q_hi": rows_out[sel, 3],
+             "t_lo": rows_out[sel, 4], "t_hi": rows_out[sel, 5]},
+            kept, dropped)
+
+
+# ---------------------------------------------------------------- driver
+
+def find_overlaps(read_seqs: List[bytes], target_seqs: List[bytes],
+                  read_self_t: np.ndarray, *,
+                  k: Optional[int] = None, w: Optional[int] = None,
+                  max_occ: Optional[int] = None,
+                  min_seeds: Optional[int] = None,
+                  resident: Optional[bool] = None
+                  ) -> Dict[str, np.ndarray]:
+    """The full first-party overlapper: seed both pools, match, chain,
+    and emit forward-strand ``Overlap``-shaped rows.
+
+    ``read_self_t[i]`` names the target index read ``i`` *is* (self-hit
+    suppression for C mode, where the draft windows are built from the
+    very reads being mapped), or -1. Returns parallel arrays ``q_ord``,
+    ``t_idx``, ``strand``, ``q_begin``, ``q_end``, ``t_begin``,
+    ``t_end``, ``n_seeds``, ``score`` canonically sorted by ``(q_ord,
+    t_idx, strand, t_begin, q_begin)`` — any intermediate ordering
+    wobble is erased here, which is what makes reruns and ``--shards``
+    replays byte-identical."""
+    from .. import flags
+    k = flags.get_int("RACON_TPU_OVERLAP_K") if k is None else k
+    w = flags.get_int("RACON_TPU_OVERLAP_W") if w is None else w
+    if max_occ is None:
+        max_occ = flags.get_int("RACON_TPU_OVERLAP_MAX_OCC")
+    if min_seeds is None:
+        min_seeds = flags.get_int("RACON_TPU_OVERLAP_MIN_SEEDS")
+    if resident is None:
+        resident = flags.get_bool("RACON_TPU_RESIDENT")
+    k = max(4, min(16, k))  # uint32 canonical codes hold 2k bits
+    w = max(1, w)
+    qlens = np.fromiter((len(s) for s in read_seqs), np.int64,
+                        len(read_seqs))
+
+    with obs.span("overlap.seed", reads=len(read_seqs),
+                  targets=len(target_seqs)):
+        rt = overlap_seed.build_seed_table(read_seqs, k=k, w=w,
+                                           resident=resident)
+        tt = overlap_seed.build_seed_table(target_seqs, k=k, w=w,
+                                           resident=resident)
+    with obs.span("overlap.match"):
+        hits, capped = match_seeds(rt, tt, read_self_t, qlens,
+                                   k=k, max_occ=max_occ)
+        metrics.inc("overlap.freq_capped_buckets", capped)
+    with obs.span("overlap.chain"):
+        chains, kept, dropped = chain_pairs(hits, k=k,
+                                            min_seeds=min_seeds)
+        metrics.inc("overlap.chains_kept", kept)
+        metrics.inc("overlap.chains_dropped", dropped)
+
+    q = chains["q"]
+    rel = chains["rel"]
+    ql = qlens[q] if q.size else np.zeros(0, np.int64)
+    # flip reverse-strand chain coords back to forward query space
+    q_begin = np.where(rel == 1, ql - (chains["q_hi"] + k), chains["q_lo"])
+    q_end = np.where(rel == 1, ql - chains["q_lo"], chains["q_hi"] + k)
+    t_begin = chains["t_lo"]
+    t_end = chains["t_hi"] + k
+    order = np.lexsort((q_begin, t_begin, rel, chains["t"], q))
+    return {"q_ord": q[order], "t_idx": chains["t"][order],
+            "strand": rel[order],
+            "q_begin": q_begin[order], "q_end": q_end[order],
+            "t_begin": t_begin[order], "t_end": t_end[order],
+            "n_seeds": chains["n_seeds"][order],
+            "score": chains["score"][order]}
+
+
+def paf_bytes(rows: Dict[str, np.ndarray], read_names: List[bytes],
+              read_lens: np.ndarray, target_names: List[bytes],
+              target_lens: np.ndarray, *, k: int) -> List[bytes]:
+    """Serialize overlapper rows as 12-column PAF lines (newline
+    included) — deterministic bytes, so the auto-mode PAF a sharded run
+    writes is identical across reruns and workers."""
+    out: List[bytes] = []
+    for i in range(rows["q_ord"].size):
+        q = int(rows["q_ord"][i])
+        t = int(rows["t_idx"][i])
+        qb, qe = int(rows["q_begin"][i]), int(rows["q_end"][i])
+        tb, te = int(rows["t_begin"][i]), int(rows["t_end"][i])
+        matches = min(int(rows["n_seeds"][i]) * k, qe - qb, te - tb)
+        alen = max(qe - qb, te - tb)
+        out.append(b"\t".join((
+            read_names[q], str(int(read_lens[q])).encode(),
+            str(qb).encode(), str(qe).encode(),
+            b"-" if int(rows["strand"][i]) else b"+",
+            target_names[t], str(int(target_lens[t])).encode(),
+            str(tb).encode(), str(te).encode(),
+            str(matches).encode(), str(alen).encode(), b"255"))
+            + b"\n")
+    return out
+
+
+# -------------------------------------------------------------- warm-up
+
+_warmed_shapes: set = set()
+
+
+def _warmup_shapes(est_seeds: int, est_pairs: int
+                   ) -> List[Tuple[int, int]]:
+    """The ``(S, B)`` chain-arena geometries a run with ~``est_pairs``
+    candidate pairs of ~``est_seeds`` seeds dispatches — derived with
+    the same :func:`_seed_bucket` / :func:`_pair_batch` quantizers the
+    dispatch path uses (consumed by :func:`warmup_async`)."""
+    if est_seeds <= 0 or est_pairs <= 0:
+        return []
+    S = _seed_bucket(est_seeds)
+    return [(S, _pair_batch(S, est_pairs))]
+
+
+def warmup_async(est_seeds: int, est_pairs: int, k: int = 15):
+    """Background warm-up compilation of the expected chain-arena
+    shapes while the host matches seeds. Shape-deduped; returns the
+    thread (for tests) or None when skipped."""
+    shapes = [(S, B, k) for S, B in _warmup_shapes(est_seeds, est_pairs)
+              if (S, B, k) not in _warmed_shapes]
+    if not shapes:
+        return None
+    _warmed_shapes.update(shapes)
+
+    def _one(S, B, kk):
+        z = np.zeros((B, S), np.int32)
+        # graftlint: disable=jit-shape-hazard (k is a run-constant flag value — one compile per run; S is the pow2 bucket)
+        out = _chain_kernel(z, z, np.zeros(B, np.int32), S=S, k=kk)
+        jax.block_until_ready(out)
+
+    def _run():
+        for S, B, kk in shapes:
+            try:
+                _one(S, B, kk)
+            except Exception as e:
+                from ..utils.logger import log_swallowed
+                log_swallowed(
+                    f"chain warm-up shape {(S, B)} failed (the run's "
+                    f"own shapes still compile on first use)", e)
+
+    import threading
+
+    # graftlint: disable=thread-lifecycle (droppable best-effort warm-up; daemon dies harmlessly at exit)
+    th = threading.Thread(target=_run, daemon=True,
+                          name="racon-chain-warmup")
+    th.start()
+    return th

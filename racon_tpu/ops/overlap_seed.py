@@ -1,0 +1,374 @@
+"""Jit'd minimizer seeding — stage one of the first-party overlapper.
+
+The reference pipeline demands precomputed overlaps from an external
+mapper (minimap2), so PAF/MHAP/SAM parsing is its entire ingest story.
+``--overlaps auto`` replaces that with an in-process minimizer-seed →
+chain overlapper (ROADMAP item 5); this module is the seeding half:
+
+- sequences pack host-side into 2-bit code arrays (A/C/G/T → 0..3,
+  anything else → 4, which invalidates every k-mer covering it) and
+  bucket by pow2 length into fixed-shape ``[B, L]`` batches, one compile
+  per bucket geometry — the same arena discipline as ``nw._AlignStream``;
+- one jit'd pass per batch builds forward and reverse-complement k-mer
+  codes (k static shifted slices), takes the strand-canonical minimum
+  (``fwd == rc`` palindrome ties are skipped, like minimap2), scrambles
+  it through an invertible 32-bit finalizer so rank ties don't follow
+  base composition, and selects each w-window's leftmost minimum with a
+  strict-< iterative sweep (deterministic: no argmin tie ambiguity);
+- selected positions scatter into a per-position mask; the host (or,
+  under ``RACON_TPU_RESIDENT=1``, a device compaction kernel that ships
+  only the selected entries over the link) flattens the batch into one
+  flat ``(hash, seq_id, pos, strand)`` table for the matcher
+  (:mod:`racon_tpu.ops.chain`).
+
+Long sequences (contig targets) are sliced into bounded window-start
+spans so the arena never scales with contig length; slices overlap by
+``k + w - 2`` bases and each window is owned by exactly one slice, so
+the union equals the whole-sequence scan (the numpy oracle
+:func:`minimizers_np` asserts this in tests/test_overlapper.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import obs
+from ..obs import metrics
+from ..parallel import fetch_global
+
+# defaults mirrored by the RACON_TPU_OVERLAP_K/W flags (k=15/w=5: ONT
+# read-vs-draft seeding; ~1/3 of positions carry a minimizer)
+DEFAULT_K = 15
+DEFAULT_W = 5
+# minimizer-arena budget in cells: every per-position working array
+# (codes, fwd/rc kmers, hashes, mask) is B*L, so the batch cap derives
+# from this one constant
+SEED_ARENA_CELLS = 1 << 22
+# window starts per kernel launch for one long sequence: contigs slice
+# into spans this size (plus k+w-2 overlap bases) so the arena never
+# scales with contig length
+SEED_SLICE = 1 << 17
+# flat-table sentinel: invalid k-mer slots (ambiguous base in window,
+# fwd==rc palindrome tie, past the sequence end) never win a window
+_HASH_MAX = 0xFFFFFFFF
+
+_BASE_LUT = np.full(256, 4, np.uint8)
+for _i, _b in enumerate(b"ACGT"):
+    _BASE_LUT[_b] = _i
+for _i, _b in enumerate(b"acgt"):
+    _BASE_LUT[_b] = _i
+
+
+# -------------------------------------------------------------- geometry
+
+def _len_bucket(n: int) -> int:
+    """pow2 length bucket for one code chunk (floor 64 so every bucket
+    admits a full k+w window) — the ONE quantizer both the dispatch
+    path and :func:`_warmup_shapes` derive chunk length from."""
+    b = 64
+    while b < n:
+        b *= 2
+    return b
+
+
+def _seed_batch(L: int, n: int) -> int:
+    """pow2 batch cap for one minimizer launch against the fixed
+    :data:`SEED_ARENA_CELLS` arena (companion quantizer of
+    :func:`_len_bucket`; shared with warm-up)."""
+    want = min(max(1, n), max(1, SEED_ARENA_CELLS // max(1, L)))
+    b = 1
+    while b < want:
+        b *= 2
+    return b
+
+
+# --------------------------------------------------------------- kernels
+
+def _mix32(h):
+    """Invertible 32-bit integer finalizer (murmur3 fmix32): minimizer
+    rank stops following base composition, and distinct canonical codes
+    can never collide (bijective on the uint32 domain)."""
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("k", "w", "L"))
+def _minimizer_kernel(codes, lens, nwin, *, k: int, w: int, L: int):
+    """One minimizer pass over a ``[B, L]`` code batch.
+
+    ``lens`` bounds each row's real bases, ``nwin`` its owned window
+    starts (slice discipline: overlap-region windows belong to the next
+    slice). Returns ``(hash [B, P] uint32, strand [B, P] bool,
+    selected [B, P] bool)`` with ``P = L - k + 1``."""
+    P = L - k + 1
+    B = codes.shape[0]
+    base = codes.astype(jnp.uint32)
+    f = jnp.zeros((B, P), jnp.uint32)
+    r = jnp.zeros((B, P), jnp.uint32)
+    bad = jnp.zeros((B, P), jnp.bool_)
+    for j in range(k):
+        c = base[:, j:j + P]
+        bad = bad | (c > jnp.uint32(3))
+        cc = c & jnp.uint32(3)
+        f = (f << jnp.uint32(2)) | cc
+        r = (r >> jnp.uint32(2)) | ((jnp.uint32(3) - cc)
+                                    << jnp.uint32(2 * (k - 1)))
+    pos = jnp.arange(P, dtype=jnp.int32)
+    in_seq = pos[None, :] + k <= lens[:, None]
+    strand = r < f  # canonical k-mer is the reverse complement
+    h = _mix32(jnp.minimum(f, r))
+    h = jnp.where(bad | (f == r) | ~in_seq, jnp.uint32(_HASH_MAX), h)
+
+    # leftmost strict-< windowed minimum over w consecutive k-mer slots
+    W = P - w + 1
+    minv = h[:, 0:W]
+    minp = jnp.zeros((B, W), jnp.int32)
+    for j in range(1, w):
+        cand = h[:, j:j + W]
+        take = cand < minv
+        minv = jnp.where(take, cand, minv)
+        minp = jnp.where(take, jnp.int32(j), minp)
+    minp = minp + pos[None, :W]
+    wvalid = (pos[None, :W] < nwin[:, None]) \
+        & (pos[None, :W] + (w + k - 1) <= lens[:, None]) \
+        & (minv != jnp.uint32(_HASH_MAX))
+    # scatter each window's pick; invalid windows park on the P slot
+    tgt = jnp.where(wvalid, minp, jnp.int32(P))
+    sel = jnp.zeros((B, P + 1), jnp.bool_)
+    sel = sel.at[jnp.arange(B, dtype=jnp.int32)[:, None], tgt].set(True)
+    return h, strand, sel[:, :P]
+
+
+@jax.jit
+def _compact_kernel(h, strand, sel):
+    """Device-side table compaction (the resident path): selected
+    entries pack to the front in row-major order — identical to the
+    host ``np.nonzero`` walk — so only ``n_selected`` elements ever
+    cross the host link instead of the full ``[B, P]`` arenas."""
+    B, P = h.shape
+    flat = sel.reshape(-1)
+    rank = jnp.cumsum(flat.astype(jnp.int32))
+    total = rank[-1]
+    idx = jnp.where(flat, rank - 1, jnp.int32(B * P))
+    lin = jnp.arange(B * P, dtype=jnp.int32)
+    out_h = jnp.zeros((B * P + 1,), jnp.uint32).at[idx].set(h.reshape(-1))
+    out_row = jnp.zeros((B * P + 1,), jnp.int32).at[idx].set(lin // P)
+    out_pos = jnp.zeros((B * P + 1,), jnp.int32).at[idx].set(lin % P)
+    out_s = jnp.zeros((B * P + 1,), jnp.bool_).at[idx].set(
+        strand.reshape(-1))
+    return out_h, out_row, out_pos, out_s, total
+
+
+# ------------------------------------------------------------ host driver
+
+def _iter_chunks(seqs: List[bytes], k: int, w: int
+                 ) -> Iterator[Tuple[int, int, bytes, int]]:
+    """``(seq_id, window_start_offset, byte_slice, n_windows)`` chunks:
+    whole short sequences, bounded overlapping slices of long ones."""
+    for sid, s in enumerate(seqs):
+        L = len(s)
+        if L < k + w - 1:
+            continue  # no complete window fits
+        n_total = L - (k + w - 1) + 1
+        for s0 in range(0, n_total, SEED_SLICE):
+            n_here = min(SEED_SLICE, n_total - s0)
+            end = min(L, s0 + n_here + (k + w - 2))
+            yield sid, s0, s[s0:end], n_here
+
+
+def build_seed_table(seqs: List[bytes], *, k: int = DEFAULT_K,
+                     w: int = DEFAULT_W, resident: bool = False
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray]:
+    """The flat minimizer table of a sequence set: parallel numpy arrays
+    ``(hash uint32, seq_id int32, pos int32, strand bool)`` in
+    deterministic (bucket-grouped, sequence-order) row order.
+
+    ``resident=True`` compacts on device and fetches only the selected
+    entries (counted into the ``dataflow.*`` bytes ledger); the host
+    path fetches the full masks and compacts with numpy. Both produce
+    identical tables (tests assert the parity)."""
+    by_bucket: dict = {}
+    for chunk in _iter_chunks(seqs, k, w):
+        by_bucket.setdefault(_len_bucket(len(chunk[2])), []).append(chunk)
+
+    hs: List[np.ndarray] = []
+    ids: List[np.ndarray] = []
+    ps: List[np.ndarray] = []
+    ss: List[np.ndarray] = []
+    for L in sorted(by_bucket):
+        chunks = by_bucket[L]
+        B_cap = _seed_batch(L, len(chunks))
+        for begin in range(0, len(chunks), B_cap):
+            part = chunks[begin:begin + B_cap]
+            B = _seed_batch(L, len(part))
+            codes = np.full((B, L), 4, np.uint8)
+            lens = np.zeros(B, np.int32)
+            nwin = np.zeros(B, np.int32)
+            for i, (_, _, blob, n_here) in enumerate(part):
+                arr = _BASE_LUT[np.frombuffer(blob, np.uint8)]
+                codes[i, :arr.size] = arr
+                lens[i] = arr.size
+                nwin[i] = n_here
+            with obs.span("overlap.seed.dispatch", rows=len(part)):
+                # graftlint: disable=jit-shape-hazard (k/w are run-constant flag values — one compile per run; L is the pow2 bucket)
+                h, strand, sel = _minimizer_kernel(codes, lens, nwin,
+                                                   k=k, w=w, L=L)
+                if resident:
+                    h, row, pcol, strand, total = _compact_kernel(
+                        h, strand, sel)
+            if resident:
+                with obs.span("overlap.seed.fetch", rows=len(part)):
+                    n_host = fetch_global([total])[0]
+                    n = int(n_host)
+                    h_np, rows, cols, s_np = fetch_global(
+                        [h[:n], row[:n], pcol[:n], strand[:n]])
+                fetched = n * 10  # 4 + 4 + 1 + 1 bytes per entry
+                metrics.inc("dataflow.bytes_fetched", fetched)
+                metrics.inc("dataflow.bytes_avoided",
+                            max(0, B * (L - k + 1) * 6 - fetched))
+            else:
+                with obs.span("overlap.seed.fetch", rows=len(part)):
+                    h_full, sel_np, s_full = fetch_global(
+                        [h, sel, strand])
+                rows, cols = np.nonzero(sel_np)
+                h_np = h_full[rows, cols]
+                s_np = s_full[rows, cols]
+            keep = h_np != np.uint32(_HASH_MAX)
+            rows, cols = rows[keep], cols[keep]
+            chunk_ids = np.fromiter((c[0] for c in part), np.int32,
+                                    len(part))
+            chunk_off = np.fromiter((c[1] for c in part), np.int32,
+                                    len(part))
+            hs.append(h_np[keep])
+            ids.append(chunk_ids[rows])
+            ps.append(chunk_off[rows] + cols.astype(np.int32))
+            ss.append(np.asarray(s_np)[keep])
+            metrics.inc("overlap.seed_lanes_total", B * L)
+            metrics.inc("overlap.seed_lanes_occupied", int(lens.sum()))
+    if not hs:
+        z = np.zeros(0, np.int32)
+        return np.zeros(0, np.uint32), z, z, np.zeros(0, bool)
+    h_all = np.concatenate(hs)
+    id_all = np.concatenate(ids)
+    p_all = np.concatenate(ps)
+    s_all = np.concatenate(ss)
+    # canonical (seq_id, pos) order, deduping the one legitimate repeat
+    # source: a position selected by windows on both sides of a slice
+    # boundary emits once per slice
+    order = np.lexsort((p_all, id_all))
+    h_all, id_all, p_all, s_all = (h_all[order], id_all[order],
+                                   p_all[order], s_all[order])
+    uniq = np.ones(h_all.size, bool)
+    uniq[1:] = (id_all[1:] != id_all[:-1]) | (p_all[1:] != p_all[:-1])
+    table = (h_all[uniq], id_all[uniq], p_all[uniq], s_all[uniq])
+    metrics.inc("overlap.minimizers", int(table[0].size))
+    return table
+
+
+# -------------------------------------------------------------- warm-up
+
+_warmed_shapes: set = set()
+
+
+def _warmup_shapes(est_len: int, est_seqs: int) -> List[Tuple[int, int]]:
+    """The ``(L, B)`` batch geometries a run over ``est_seqs`` sequences
+    of roughly ``est_len`` bases dispatches — derived with the same
+    :func:`_len_bucket` / :func:`_seed_batch` quantizers the driver
+    uses (ONE source of truth, consumed by :func:`warmup_async`)."""
+    if est_len <= 0 or est_seqs <= 0:
+        return []
+    chunk_len = min(est_len, SEED_SLICE + DEFAULT_K + DEFAULT_W - 2)
+    L = _len_bucket(chunk_len)
+    return [(L, _seed_batch(L, est_seqs))]
+
+
+def warmup_async(est_len: int, est_seqs: int,
+                 k: int = DEFAULT_K, w: int = DEFAULT_W):
+    """Background warm-up compilation of the expected minimizer batch
+    shapes (the overlapper analog of ``TpuAligner.warmup_async``):
+    executes the kernel once per shape on near-empty inputs while the
+    host packs real code arrays. Shape-deduped; returns the thread
+    (for tests) or None when skipped (zero estimates, every shape
+    already warmed)."""
+    shapes = [(L, B, k, w) for L, B in _warmup_shapes(est_len, est_seqs)
+              if (L, B, k, w) not in _warmed_shapes]
+    if not shapes:
+        return None
+    _warmed_shapes.update(shapes)
+
+    def _one(L, B, kk, ww):
+        codes = np.full((B, L), 4, np.uint8)
+        ones = np.ones(B, np.int32)
+        # graftlint: disable=jit-shape-hazard (k/w are run-constant flag values — one compile per run; L is the pow2 bucket)
+        out = _minimizer_kernel(codes, ones, ones, k=kk, w=ww, L=L)
+        jax.block_until_ready(out[0])
+
+    def _run():
+        for L, B, kk, ww in shapes:
+            try:
+                _one(L, B, kk, ww)
+            except Exception as e:
+                from ..utils.logger import log_swallowed
+                log_swallowed(
+                    f"minimizer warm-up shape {(L, B)} failed (the "
+                    f"run's own shapes still compile on first use)", e)
+
+    import threading
+
+    # graftlint: disable=thread-lifecycle (droppable best-effort warm-up; daemon dies harmlessly at exit)
+    th = threading.Thread(target=_run, daemon=True,
+                          name="racon-seed-warmup")
+    th.start()
+    return th
+
+
+# --------------------------------------------------------- numpy oracle
+
+def minimizers_np(seq: bytes, k: int = DEFAULT_K, w: int = DEFAULT_W
+                  ) -> List[Tuple[int, int, int]]:
+    """Pure-numpy single-sequence oracle: sorted-by-position
+    ``(hash, pos, strand)`` triples with exactly the kernel's
+    semantics (canonical min, fmix32, palindrome/ambiguity skips,
+    leftmost strict-< window minimum)."""
+    codes = _BASE_LUT[np.frombuffer(seq, np.uint8)]
+    L = codes.size
+    if L < k + w - 1:
+        return []
+    P = L - k + 1
+    f = np.zeros(P, np.uint32)
+    r = np.zeros(P, np.uint32)
+    bad = np.zeros(P, bool)
+    for j in range(k):
+        c = codes[j:j + P].astype(np.uint32)
+        bad |= c > 3
+        cc = c & np.uint32(3)
+        f = (f << np.uint32(2)) | cc
+        r = (r >> np.uint32(2)) | ((np.uint32(3) - cc)
+                                   << np.uint32(2 * (k - 1)))
+    strand = r < f
+    h = np.minimum(f, r)
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    h = np.where(bad | (f == r), np.uint32(_HASH_MAX), h)
+    sel = np.zeros(P, bool)
+    for s in range(P - w + 1):
+        win = h[s:s + w]
+        m = int(win.min())
+        if m != _HASH_MAX:
+            sel[s + int(np.argmax(win == m))] = True
+    return [(int(h[p]), int(p), int(strand[p]))
+            for p in np.flatnonzero(sel)]

@@ -111,10 +111,18 @@ def plan(sequences_path: str, overlaps_path: str, target_path: str,
     from .core.polisher import PolisherType
     from .exec import build_index, parse_ram, plan_shards
     from .exec.heartbeat import peak_rss_bytes
+    from .exec.index import build_index_readsonly
+    from .io import parsers
 
-    index = build_index(sequences_path, overlaps_path, target_path,
-                        PolisherType.F if fragment_correction
-                        else PolisherType.C, error_threshold)
+    if parsers.is_auto_overlaps(overlaps_path):
+        # --overlaps auto: no overlaps file exists at planning time —
+        # cost from reads + target sizes only (reads apportioned to
+        # contigs by contig size)
+        index = build_index_readsonly(sequences_path, target_path)
+    else:
+        index = build_index(sequences_path, overlaps_path, target_path,
+                            PolisherType.F if fragment_correction
+                            else PolisherType.C, error_threshold)
     sp = plan_shards(index, n_shards,
                      parse_ram(max_ram) if max_ram else 0, split_bytes,
                      base_rss=peak_rss_bytes())

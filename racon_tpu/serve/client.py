@@ -168,9 +168,13 @@ def spec_from_args(args) -> dict:
     """A submit spec from the parsed ``racon`` CLI namespace — the
     one-shot option surface forwarded verbatim, so ``--submit`` output
     matches the equivalent one-shot invocation byte for byte."""
+    from ..io import parsers
     return {
         "sequences": os.path.abspath(args.sequences),
-        "overlaps": os.path.abspath(args.overlaps),
+        # the --overlaps auto sentinel travels verbatim (no file)
+        "overlaps": (args.overlaps
+                     if parsers.is_auto_overlaps(args.overlaps)
+                     else os.path.abspath(args.overlaps)),
         "target_sequences": os.path.abspath(args.target_sequences),
         "fragment_correction": bool(args.fragment_correction),
         "window_length": args.window_length,

@@ -442,6 +442,11 @@ class PolishServer:
         if err is not None:
             return None, err, False
         for pkey in protocol.SPEC_PATHS:
+            if pkey == "overlaps" \
+                    and parsers.is_auto_overlaps(spec[pkey]):
+                # first-party overlapper: the job is self-contained
+                # (reads + target, no overlaps upload)
+                continue
             spec[pkey] = os.path.abspath(spec[pkey])
             if not os.path.isfile(spec[pkey]):
                 return None, f"input not found: {spec[pkey]}", False
@@ -450,7 +455,8 @@ class PolishServer:
             if parsers.sequence_parser_for(path) is None:
                 return None, (f"{kind} file {path} has an unsupported "
                               f"format extension"), False
-        if parsers.overlap_parser_for(spec["overlaps"]) is None:
+        if not parsers.is_auto_overlaps(spec["overlaps"]) \
+                and parsers.overlap_parser_for(spec["overlaps"]) is None:
             return None, (f"overlaps file {spec['overlaps']} has an "
                           f"unsupported format extension"), False
         profile = (self.match, self.mismatch, self.gap, self.banded)

@@ -318,6 +318,57 @@ def test_report_v8_requires_dataflow_section():
     metrics.clear("job.df1.")
 
 
+def test_report_v9_requires_overlap_section():
+    """Schema v9: the first-party overlapper accounting section is
+    required — mode 'paf' with zeros for precomputed-overlap runs,
+    mode 'auto' with the seed/match/chain numbers when the in-process
+    overlapper generated the rows — and validated key-by-key."""
+    metrics.clear("overlap.")
+    rep = report.build_report("cli")
+    assert report.validate_report(rep) == []
+    ov = rep["overlap"]
+    assert ov["mode"] == "paf"
+    for key in ("minimizers", "candidate_pairs", "freq_capped_buckets",
+                "chains_kept", "chains_dropped", "seed_dispatch_s",
+                "seed_fetch_s", "chain_dispatch_s", "chain_fetch_s"):
+        assert ov[key] == 0, (key, ov)
+    broken = dict(rep)
+    del broken["overlap"]
+    assert any("overlap" in e for e in report.validate_report(broken))
+    bad = dict(rep, overlap=dict(ov, chains_kept="many"))
+    assert any("chains_kept" in e for e in report.validate_report(bad))
+    bad = dict(rep, overlap=dict(ov, mode="minimap2"))
+    assert any("mode" in e for e in report.validate_report(bad))
+    bad = dict(rep, overlap={k: v for k, v in ov.items()
+                             if k != "minimizers"})
+    assert any("minimizers" in e for e in report.validate_report(bad))
+
+    # an auto run's numbers flow through (scoped, like a job report)
+    metrics.set_scope("job.ov1.")
+    try:
+        metrics.set_gauge("overlap.mode_auto", 1)
+        metrics.inc("overlap.minimizers", 1234)
+        metrics.inc("overlap.candidate_pairs", 56)
+        metrics.inc("overlap.freq_capped_buckets", 7)
+        metrics.inc("overlap.chains_kept", 40)
+        metrics.inc("overlap.chains_dropped", 16)
+        metrics.add_time("overlap.seed.dispatch", 0.5)
+        metrics.add_time("overlap.chain.fetch", 0.25)
+    finally:
+        metrics.set_scope(None)
+    scoped = report.build_report("job", scope="job.ov1.")
+    assert report.validate_report(scoped) == []
+    assert scoped["overlap"]["mode"] == "auto"
+    assert scoped["overlap"]["minimizers"] == 1234
+    assert scoped["overlap"]["candidate_pairs"] == 56
+    assert scoped["overlap"]["freq_capped_buckets"] == 7
+    assert scoped["overlap"]["chains_kept"] == 40
+    assert scoped["overlap"]["chains_dropped"] == 16
+    assert scoped["overlap"]["seed_dispatch_s"] == 0.5
+    assert scoped["overlap"]["chain_fetch_s"] == 0.25
+    metrics.clear("job.ov1.")
+
+
 def test_report_shard_row_filters_manifest_keys():
     entry = {"id": 3, "status": "done", "part": "part_0003.fasta",
              "contigs": [1, 2], "engine": "primary", "mbp": 1.25,

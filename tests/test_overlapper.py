@@ -1,0 +1,365 @@
+"""First-party overlapper suite (``--overlaps auto``): randomized
+kernel-vs-numpy-oracle parity for both stages (minimizer seeding and
+chain DP), strand canonicalization, the slice-boundary dedup, the
+resident fetch path, frequency-cap accounting, warm-up shape caching,
+and the end-to-end determinism contract — auto-mode polish output
+byte-identical across thread counts and ``--shards 2``, gz/FASTQ/FASTA
+input variants producing identical auto PAFs, F mode, and the
+planner/rampler no-overlaps-file cases.
+"""
+
+import gzip
+import io
+import pathlib
+
+import numpy as np
+import pytest
+
+from test_columnar_init import write_synthetic_assembly
+
+from racon_tpu.core.polisher import PolisherType, create_polisher
+from racon_tpu.exec import ShardRunner
+from racon_tpu.exec.index import build_index_readsonly, write_auto_paf
+from racon_tpu.exec.planner import estimate_job_cost
+from racon_tpu.io import parsers
+from racon_tpu.ops import chain, overlap_seed
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+_ACGT = np.frombuffer(b"ACGT", np.uint8)
+_COMP = bytes.maketrans(b"ACGT", b"TGCA")
+
+
+def rand_seq(rng, n):
+    return rng.choice(_ACGT, size=n).astype(np.uint8).tobytes()
+
+
+def revcomp(s):
+    return s.translate(_COMP)[::-1]
+
+
+def table_rows(table):
+    h, i, p, s = table
+    return list(zip(i.tolist(), p.tolist(), h.tolist(),
+                    np.asarray(s, bool).tolist()))
+
+
+# ------------------------------------------------- stage 1: minimizers
+
+def test_minimizer_matches_numpy_oracle():
+    """The jit'd minimizer kernel agrees with the pure-numpy oracle
+    exactly — randomized lengths, several (k, w) geometries, ambiguous
+    bases included."""
+    rng = np.random.default_rng(11)
+    for k, w in ((15, 5), (11, 3), (8, 7), (4, 1)):
+        for trial in range(4):
+            n = int(rng.integers(k + w - 1, 3000))
+            seq = bytearray(rand_seq(rng, n))
+            if trial % 2:  # sprinkle ambiguity
+                for j in rng.integers(0, n, size=max(1, n // 50)):
+                    seq[int(j)] = ord(b"N")
+            seq = bytes(seq)
+            got = table_rows(overlap_seed.build_seed_table(
+                [seq], k=k, w=w))
+            want = [(0, p, h, bool(s))
+                    for h, p, s in overlap_seed.minimizers_np(seq, k, w)]
+            assert got == want, (k, w, trial, n)
+
+
+def test_minimizer_strand_canonical():
+    """Reverse-complementing a sequence yields the same canonical hash
+    multiset with mirrored positions (p -> L - k - p) and flipped
+    strand bits — the property seed matching across strands rests on."""
+    rng = np.random.default_rng(12)
+    k, w = 15, 5
+    seq = rand_seq(rng, 1200)
+    fwd = overlap_seed.minimizers_np(seq, k, w)
+    rev = overlap_seed.minimizers_np(revcomp(seq), k, w)
+    L = len(seq)
+    # windowed selection differs at the edges, but every interior
+    # minimizer must appear mirrored; compare the intersection both ways
+    fset = {(h, p, s) for h, p, s in fwd}
+    rset = {(h, p, s) for h, p, s in rev}
+    mirrored = {(h, L - k - p, 1 - s) for h, p, s in rev}
+    assert len(fset & mirrored) >= int(0.9 * min(len(fset), len(rset)))
+    assert {h for h, _, _ in fset} == {h for h, _, _ in mirrored}
+
+
+def test_minimizer_slice_boundary_dedup(monkeypatch):
+    """Long sequences are seeded in bounded overlapping slices; a
+    minimizer selected by windows on both sides of a slice boundary
+    must emit ONCE. Shrinking SEED_SLICE forces many boundaries through
+    a short sequence so the dedup is exercised cheaply."""
+    rng = np.random.default_rng(13)
+    seq = rand_seq(rng, 700)
+    want = table_rows(overlap_seed.build_seed_table([seq]))
+    monkeypatch.setattr(overlap_seed, "SEED_SLICE", 64)
+    got = table_rows(overlap_seed.build_seed_table([seq]))
+    assert got == want
+
+
+def test_seed_table_resident_matches_host():
+    """The device-compaction fetch path returns the identical table to
+    the host nonzero path (order included)."""
+    rng = np.random.default_rng(14)
+    seqs = [rand_seq(rng, int(n)) for n in rng.integers(80, 1500, 6)]
+    host = table_rows(overlap_seed.build_seed_table(seqs))
+    res = table_rows(overlap_seed.build_seed_table(seqs, resident=True))
+    assert res == host
+
+
+def test_seed_table_skips_short_sequences():
+    rng = np.random.default_rng(15)
+    k, w = 15, 5
+    table = overlap_seed.build_seed_table(
+        [b"ACGT", rand_seq(rng, 400), b""], k=k, w=w)
+    assert set(table[1].tolist()) == {1}
+
+
+# --------------------------------------------------- stage 2: chain DP
+
+def test_chain_kernel_matches_numpy_oracle():
+    """The banded chain DP kernel reproduces the integer numpy oracle
+    bit-exactly over randomized seed sets (score, seed count, and the
+    chained span)."""
+    rng = np.random.default_rng(21)
+    k = 15
+    for S in (16, 32):
+        B = chain._pair_batch(S, 3)
+        ts = np.zeros((B, S), np.int32)
+        qs = np.zeros((B, S), np.int32)
+        ns = np.zeros(B, np.int32)
+        for lane in range(3):
+            n = int(rng.integers(S // 2, S + 1))
+            t = np.sort(rng.integers(0, 4000, n)).astype(np.int32)
+            q = (t + rng.integers(-300, 300, n)).clip(0).astype(np.int32)
+            ts[lane, :n], qs[lane, :n], ns[lane] = t, q, n
+        out = np.asarray(chain._chain_kernel(ts, qs, ns, S=S, k=k))
+        for lane in range(3):
+            n = int(ns[lane])
+            want = chain.chain_np(ts[lane, :n], qs[lane, :n], k)
+            assert out[lane].tolist() == list(want), (S, lane)
+
+
+def test_find_overlaps_exact_spans():
+    """Reads cut verbatim from a target map back to their exact source
+    spans with the right strand (forward and reverse-complement)."""
+    rng = np.random.default_rng(22)
+    target = rand_seq(rng, 8000)
+    fwd = target[1000:4000]
+    rev = revcomp(target[4500:7500])
+    rows = chain.find_overlaps([fwd, rev], [target],
+                               np.full(2, -1, np.int64),
+                               k=15, w=5, max_occ=64, min_seeds=4)
+    for q, strand, t_lo, t_hi in ((0, 0, 1000, 4000),
+                                  (1, 1, 4500, 7500)):
+        mine = np.flatnonzero(rows["q_ord"] == q)
+        assert mine.size == 1
+        i = int(mine[0])
+        assert int(rows["strand"][i]) == strand
+        assert abs(int(rows["t_begin"][i]) - t_lo) < 40
+        assert abs(int(rows["t_end"][i]) - t_hi) < 40
+        span = int(rows["q_end"][i]) - int(rows["q_begin"][i])
+        assert span > 2800
+
+
+def test_find_overlaps_suppresses_self_hits():
+    """C-mode self suppression: a read that IS target j emits no row
+    against j, but still maps to other targets."""
+    rng = np.random.default_rng(23)
+    t0 = rand_seq(rng, 3000)
+    t1 = t0[:2000] + rand_seq(rng, 1000)  # shares a 2 kb prefix
+    rows = chain.find_overlaps([t0], [t0, t1],
+                               np.array([0], np.int64), k=15, w=5)
+    assert 0 not in rows["t_idx"].tolist()
+    assert 1 in rows["t_idx"].tolist()
+
+
+def test_freq_cap_accounting():
+    """Buckets hotter than max_occ drop WHOLE and are counted — never
+    silently; raising the cap readmits them."""
+    rng = np.random.default_rng(24)
+    motif = rand_seq(rng, 400)
+    reads = [motif] * 12  # every minimizer bucket has 12+12 entries
+    rt = overlap_seed.build_seed_table(reads)
+    tt = overlap_seed.build_seed_table(reads)
+    self_t = np.full(12, -1, np.int64)
+    qlens = np.full(12, 400, np.int64)
+    hits, capped = chain.match_seeds(rt, tt, self_t, qlens,
+                                     k=15, max_occ=4)
+    assert capped > 0 and hits["q"].size == 0
+    hits2, capped2 = chain.match_seeds(rt, tt, self_t, qlens,
+                                       k=15, max_occ=64)
+    assert capped2 == 0 and hits2["q"].size > 0
+
+
+def test_min_seeds_drop_accounting():
+    """Pairs under the min_seeds floor are dropped and counted, both
+    pre-DP (candidate too small) and post-DP (chain too small)."""
+    rng = np.random.default_rng(25)
+    target = rand_seq(rng, 4000)
+    reads = [target[500:2500], rand_seq(rng, 2000)]
+    rows_loose = chain.find_overlaps(reads, [target],
+                                     np.full(2, -1, np.int64),
+                                     k=15, w=5, min_seeds=4)
+    rows_tight = chain.find_overlaps(reads, [target],
+                                     np.full(2, -1, np.int64),
+                                     k=15, w=5, min_seeds=10 ** 6)
+    assert rows_loose["q_ord"].size > 0
+    assert rows_tight["q_ord"].size == 0
+
+
+# ------------------------------------------------------------- warm-up
+
+def test_warmup_shape_cache():
+    """warmup_async compiles each (shape, k, w) geometry once per
+    process: the first call returns a live thread, an identical second
+    call is a cache hit and returns None (the cache-size claim — the
+    set grows by exactly the new shapes)."""
+    before = len(overlap_seed._warmed_shapes)
+    th = overlap_seed.warmup_async(900, 7, k=9, w=4)
+    assert th is not None
+    th.join(60.0)
+    assert not th.is_alive()
+    assert len(overlap_seed._warmed_shapes) == before + 1
+    assert overlap_seed.warmup_async(900, 7, k=9, w=4) is None
+    assert len(overlap_seed._warmed_shapes) == before + 1
+
+    before_c = len(chain._warmed_shapes)
+    th_c = chain.warmup_async(24, 5, k=9)
+    assert th_c is not None
+    th_c.join(60.0)
+    assert not th_c.is_alive()
+    assert len(chain._warmed_shapes) == before_c + 1
+    assert chain.warmup_async(24, 5, k=9) is None
+    assert len(chain._warmed_shapes) == before_c + 1
+
+
+def test_warmup_zero_estimates_skip():
+    assert overlap_seed.warmup_async(0, 0) is None
+    assert chain.warmup_async(0, 0) is None
+
+
+# ------------------------------------------- end-to-end: --overlaps auto
+
+def fasta_bytes(seqs):
+    return b"".join(b">" + s.name + b"\n" + s.data + b"\n" for s in seqs)
+
+
+def auto_single_shot(rp, lp, num_threads=4, type_=PolisherType.C):
+    p = create_polisher(str(rp), parsers.AUTO_OVERLAPS, str(lp), type_,
+                        num_threads=num_threads)
+    return fasta_bytes(p.run(True))
+
+
+@pytest.fixture(scope="module")
+def assembly(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ovl")
+    return write_synthetic_assembly(tmp, seed=41, n_contigs=2,
+                                    contig=3000)
+
+
+def test_auto_mode_polishes(assembly):
+    """--overlaps auto end-to-end on the synthetic assembly: both
+    contigs polish (the PAF-free path finds the read pile-ups), and the
+    output carries the standard polished headers."""
+    rp, _, lp = assembly
+    out = auto_single_shot(rp, lp)
+    assert out.count(b">") == 2
+    assert b"ctg0" in out and b"ctg1" in out
+
+
+def test_auto_mode_thread_determinism(assembly):
+    """Auto-mode output is byte-identical across worker thread counts
+    (the overlapper sorts canonically; threading must not leak in)."""
+    rp, _, lp = assembly
+    assert auto_single_shot(rp, lp, num_threads=1) == \
+        auto_single_shot(rp, lp, num_threads=4)
+
+
+def test_auto_mode_shards_byte_identical(assembly, tmp_path):
+    """A --shards 2 auto run (PAF materialized into the work dir, index
+    replayed over it) is byte-identical to the single-shot in-memory
+    path — the acceptance determinism contract."""
+    rp, _, lp = assembly
+    want = auto_single_shot(rp, lp)
+    runner = ShardRunner(str(rp), parsers.AUTO_OVERLAPS, str(lp),
+                         work_dir=str(tmp_path / "work"), n_shards=2,
+                         num_threads=4)
+    buf = io.BytesIO()
+    summary = runner.run(buf)
+    assert buf.getvalue() == want
+    assert summary["n_shards"] == 2
+    assert (tmp_path / "work" / "auto_overlaps.paf").stat().st_size > 0
+
+
+def test_auto_mode_f_mode(assembly):
+    """Fragment correction (-f) with auto overlaps: reads map against
+    the read pool itself with self-hits suppressed, and correction
+    emits corrected reads."""
+    rp, _, _ = assembly
+    out = auto_single_shot(rp, rp, type_=PolisherType.F)
+    assert out.count(b">") > 10
+
+
+def test_auto_paf_input_variants(assembly, tmp_path):
+    """write_auto_paf emits identical PAF bytes whether the reads
+    arrive as FASTQ, gzipped FASTQ, or FASTA — parser-layer variance
+    must not reach the overlapper."""
+    rp, _, lp = assembly
+    raw = pathlib.Path(rp).read_bytes()
+    gz = tmp_path / "reads.fastq.gz"
+    with gzip.open(gz, "wb") as f:
+        f.write(raw)
+    fa = tmp_path / "reads.fasta"
+    lines = raw.split(b"\n")
+    with open(fa, "wb") as f:
+        for i in range(0, len(lines) - 3, 4):
+            f.write(b">" + lines[i][1:] + b"\n" + lines[i + 1] + b"\n")
+    outs = []
+    for i, reads in enumerate((rp, gz, fa)):
+        paf = tmp_path / f"auto{i}.paf"
+        write_auto_paf(str(reads), str(lp), str(paf))
+        outs.append(paf.read_bytes())
+    assert outs[0] and outs[0] == outs[1] == outs[2]
+
+
+def test_auto_mode_rejects_bad_extension_still(assembly):
+    """'auto' is a sentinel, not a loosened parser: a real path with an
+    unknown extension still raises."""
+    rp, _, lp = assembly
+    with pytest.raises(ValueError, match="auto"):
+        create_polisher(str(rp), "overlaps.xyz", str(lp),
+                        PolisherType.C, num_threads=1)
+
+
+# ----------------------------------------- planner / rampler auto cases
+
+def test_estimate_job_cost_auto(assembly):
+    """Auto jobs have no overlaps file: the estimate charges the reads
+    term once more instead, and never trips on a missing path."""
+    rp, pp, lp = assembly
+    auto = estimate_job_cost(str(rp), parsers.AUTO_OVERLAPS, str(lp))
+    paf = estimate_job_cost(str(rp), str(pp), str(lp))
+    assert auto > 0 and paf > 0
+
+
+def test_rampler_plan_auto(assembly):
+    """rampler plan with --overlaps auto: a reads-only index (reads
+    apportioned to contigs by size) feeds the planner without a PAF."""
+    from racon_tpu import rampler
+    rp, _, lp = assembly
+    out = rampler.plan(str(rp), parsers.AUTO_OVERLAPS, str(lp),
+                       n_shards=2)
+    assert out["n_contigs"] == 2 and out["n_overlaps"] == 0
+    assert len(out["shards"]) == 2
+    assert all(s["contigs"] for s in out["shards"])
+
+
+def test_readsonly_index_apportions_reads(assembly):
+    rp, _, lp = assembly
+    idx = build_index_readsonly(str(rp), str(lp))
+    assert idx.uniform_read_bases > 0
+    per_contig = idx.contig_read_bytes()
+    assert per_contig.size == len(idx.targets)
+    assert all(int(b) > 0 for b in per_contig)
+    assert int(per_contig.sum()) <= idx.uniform_read_bases

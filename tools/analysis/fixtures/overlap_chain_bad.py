@@ -1,0 +1,43 @@
+"""Seeded overlapper-shaped jit-shape-hazard violations (expect 3):
+unbounded seed/pair counts reaching the chain kernel's static arena
+geometry — raw ``len()`` through a forwarding launcher, an un-quantized
+hit aggregate, and a per-call varying static."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("S", "B"))
+def chain_kernel(ts, *, S, B):
+    arena = jnp.zeros((B, S), jnp.int32)
+    return ts + arena[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def score_kernel(ts, *, k):
+    return ts * jnp.full((4,), k)
+
+
+def launch(ts, S, B):
+    # forwards into the chain kernel statics: shape-determining by
+    # propagation
+    return chain_kernel(ts, S=S, B=B)
+
+
+def drive_raw_pair_count(ts, pairs):
+    # BAD: len() of the runtime candidate-pair list reaches the arena
+    # batch dimension through launch()
+    return launch(ts, 32, len(pairs))
+
+
+def drive_unquantized_seeds(ts, hits):
+    total = sum(len(h) for h in hits)
+    # BAD: un-quantized seed aggregate becomes the lane width directly
+    return chain_kernel(ts, S=total, B=16)
+
+
+def drive_clock_k(ts):
+    # BAD: a per-call varying value as a compiled static
+    return score_kernel(ts, k=int(time.monotonic()))

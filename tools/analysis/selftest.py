@@ -58,6 +58,11 @@ EXPECT = {
     # roots are exactly the transfers the resident path eliminates
     "resident_dataflow_bad.py": ("host-transfer-in-jit", 3, 0),
     "resident_dataflow_ok.py": ("host-transfer-in-jit", 0, 1),
+    # round 20: the first-party overlapper shape — seed/chain arena
+    # geometry statics fed raw runtime counts vs the shared-quantizer
+    # discipline overlap_seed.py/chain.py actually use
+    "overlap_chain_bad.py": ("jit-shape-hazard", 3, 0),
+    "overlap_chain_ok.py": ("warmup-coverage", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }
