@@ -263,83 +263,60 @@ class Polisher:
         log.log("[racon_tpu::Polisher::initialize] loaded sequences")
         log.log()
 
-        if parsers.overlaps_mode(self.overlaps_path) == "auto":
-            overlaps = self._generate_overlaps(raw_index, name_to_id,
-                                               id_to_id)
+        auto_mode = parsers.overlaps_mode(self.overlaps_path) == "auto"
+        stream_auto = (auto_mode and not self.prefiltered_overlaps
+                       and flags.get_bool("RACON_TPU_OVERLAP_RAGGED"))
+        if stream_auto:
+            # streaming overlap->align handoff: filtered overlap rows
+            # come off the chain stream per query group and feed the
+            # align session incrementally — generation, filtering, and
+            # alignment dispatch interleave instead of phase-barriering
+            overlaps = self._generate_overlaps_stream(
+                raw_index, name_to_id, id_to_id,
+                has_name, has_data, has_reverse, t_parse)
         else:
-            with obs.span("parse.overlaps"):
-                oparse = parsers.overlap_parser_for(self.overlaps_path)
-                overlaps = []
-                for rec in oparse(self.overlaps_path):
-                    o = Overlap.from_record(rec)
-                    o.transmute(self.sequences, name_to_id, id_to_id)
-                    if o.is_valid:
-                        overlaps.append(o)
-
-        with obs.span("overlap.filter"):
-            if not self.prefiltered_overlaps:
-                overlaps = self._filter_overlaps(overlaps)
-        if not overlaps:
-            raise ValueError("empty overlap set")
-
-        for o in overlaps:
-            if o.strand:
-                has_reverse[o.q_id] = True
+            if auto_mode:
+                overlaps = self._generate_overlaps(raw_index, name_to_id,
+                                                   id_to_id)
             else:
-                has_data[o.q_id] = True
+                with obs.span("parse.overlaps"):
+                    oparse = parsers.overlap_parser_for(self.overlaps_path)
+                    overlaps = []
+                    for rec in oparse(self.overlaps_path):
+                        o = Overlap.from_record(rec)
+                        o.transmute(self.sequences, name_to_id, id_to_id)
+                        if o.is_valid:
+                            overlaps.append(o)
 
-        log.log("[racon_tpu::Polisher::initialize] loaded overlaps")
-        log.log()
+            with obs.span("overlap.filter"):
+                if not self.prefiltered_overlaps:
+                    overlaps = self._filter_overlaps(overlaps)
+            if not overlaps:
+                raise ValueError("empty overlap set")
 
-        # Kick off background warm-up compilation of the consensus
-        # refinement loop NOW, from the overlap/target histograms: the
-        # first consensus compile (~16 s) then hides inside the device
-        # overlap alignment below instead of stalling polish(). Skipped
-        # for tiny inputs (the compile would outlive the whole run) and
-        # via RACON_TPU_WARMUP=0; a wrong shape estimate only wastes a
-        # background compile (see TpuPoaConsensus.warmup_async).
-        warm = getattr(self.consensus, "warmup_async", None)
-        if warm is not None and flags.get_bool("RACON_TPU_WARMUP"):
-            est_pairs = sum(o.length // self.window_length + 1
-                            for o in overlaps)
-            targets_bases = sum(len(self.sequences[i].data)
-                                for i in range(self.targets_size))
-            est_windows = targets_bases // self.window_length + \
-                self.targets_size
-            # threshold: below ~16k pairs the whole polish costs less
-            # than the compile the warm-up would race to hide
-            if est_pairs >= 16384:
-                warm(self.window_length, est_pairs, est_windows,
-                     est_contigs=self.targets_size)
+            for o in overlaps:
+                if o.strand:
+                    has_reverse[o.q_id] = True
+                else:
+                    has_data[o.q_id] = True
 
-        # transmute-parallelism (reference P3: one future per sequence,
-        # ``polisher.cpp:368-377``): revcomp materialization is a numpy
-        # LUT-take + flip (``sequence.py``), which releases the GIL on
-        # real read lengths, so a thread pool parallelizes it (chunked —
-        # per-item futures cost more than most transmutes)
-        with obs.span("transmute"):
-            if self.num_threads > 1 and len(self.sequences) > 64:
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(self.num_threads) as pool:
-                    list(pool.map(
-                        lambda iv: iv[1].transmute(has_name[iv[0]],
-                                                   has_data[iv[0]],
-                                                   has_reverse[iv[0]]),
-                        enumerate(self.sequences), chunksize=64))
-            else:
-                for i, seq in enumerate(self.sequences):
-                    seq.transmute(has_name[i], has_data[i],
-                                  has_reverse[i])
+            log.log("[racon_tpu::Polisher::initialize] loaded overlaps")
+            log.log()
 
-        # builder-path writes (here through _assemble_layers) run on
-        # EITHER the main thread (initialize()/polish()) OR run()'s
-        # single producer thread — never both: exactly one builder runs
-        # per polisher, and the queue sentinel orders its last write
-        # before the consumer continues
-        # graftlint: disable=lock-discipline (one builder thread per polisher; paths are alternatives, ordered by the queue sentinel)
-        self.timings["parse_s"] = round(time.perf_counter() - t_parse, 3)
+            self._kick_consensus_warmup(
+                sum(o.length // self.window_length + 1 for o in overlaps))
+            self._transmute_all(has_name, has_data, has_reverse)
 
-        self.find_overlap_breaking_points(overlaps)
+            # builder-path writes (here through _assemble_layers) run on
+            # EITHER the main thread (initialize()/polish()) OR run()'s
+            # single producer thread — never both: exactly one builder
+            # runs per polisher, and the queue sentinel orders its last
+            # write before the consumer continues
+            # graftlint: disable=lock-discipline (one builder thread per polisher; paths are alternatives, ordered by the queue sentinel)
+            self.timings["parse_s"] = round(
+                time.perf_counter() - t_parse, 3)
+
+            self.find_overlap_breaking_points(overlaps)
 
         # backbone windows build AFTER alignment: a failed alignment then
         # leaves self.windows empty, so the double-init guard stays
@@ -397,6 +374,155 @@ class Polisher:
                         "overlaps (first-party overlapper)")
         return overlaps
 
+    def _kick_consensus_warmup(self, est_pairs: int) -> None:
+        """Background warm-up compilation of the consensus refinement
+        loop from the overlap/target histograms: the first consensus
+        compile (~16 s) then hides inside the device overlap alignment
+        instead of stalling polish(). Skipped for tiny inputs (the
+        compile would outlive the whole run) and via RACON_TPU_WARMUP=0;
+        a wrong shape estimate only wastes a background compile (see
+        TpuPoaConsensus.warmup_async)."""
+        warm = getattr(self.consensus, "warmup_async", None)
+        if warm is None or not flags.get_bool("RACON_TPU_WARMUP"):
+            return
+        targets_bases = sum(len(self.sequences[i].data)
+                            for i in range(self.targets_size))
+        est_windows = targets_bases // self.window_length + \
+            self.targets_size
+        # threshold: below ~16k pairs the whole polish costs less
+        # than the compile the warm-up would race to hide
+        if est_pairs >= 16384:
+            warm(self.window_length, est_pairs, est_windows,
+                 est_contigs=self.targets_size)
+
+    def _transmute_all(self, has_name, has_data, has_reverse) -> None:
+        """transmute-parallelism (reference P3: one future per sequence,
+        ``polisher.cpp:368-377``): revcomp materialization is a numpy
+        LUT-take + flip (``sequence.py``), which releases the GIL on
+        real read lengths, so a thread pool parallelizes it (chunked —
+        per-item futures cost more than most transmutes)."""
+        with obs.span("transmute"):
+            if self.num_threads > 1 and len(self.sequences) > 64:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(self.num_threads) as pool:
+                    list(pool.map(
+                        lambda iv: iv[1].transmute(has_name[iv[0]],
+                                                   has_data[iv[0]],
+                                                   has_reverse[iv[0]]),
+                        enumerate(self.sequences), chunksize=64))
+            else:
+                for i, seq in enumerate(self.sequences):
+                    seq.transmute(has_name[i], has_data[i],
+                                  has_reverse[i])
+
+    def _generate_overlaps_stream(self, raw_index: int,
+                                  name_to_id: Dict[bytes, int],
+                                  id_to_id: Dict[int, int],
+                                  has_name, has_data, has_reverse,
+                                  t_parse: float) -> List[Overlap]:
+        """``--overlaps auto`` under ``RACON_TPU_OVERLAP_RAGGED``: the
+        streaming overlap→align handoff. Chained overlap rows arrive per
+        query group (:func:`racon_tpu.ops.chain.iter_overlap_groups`),
+        run through exactly the :meth:`_filter_overlaps` consecutive-run
+        sweep as the runs complete, and feed the align session in
+        batches — so chaining for query group N+1 overlaps alignment
+        dispatch for group N. Kept overlaps accumulate in feed order,
+        which IS the barrier path's order (the canonical row sort's
+        primary key is the query ordinal), so the polished output stays
+        byte-identical to the phase-barriered path."""
+        from ..ops import chain as chain_ops
+        from ..ops import overlap_seed
+        metrics.set_gauge("overlap.mode_auto", 1)
+        metrics.set_gauge("overlap.streamed", 1)
+        read_pos = [id_to_id[i << 1] for i in range(raw_index)]
+        read_seqs = [self.sequences[p].data for p in read_pos]
+        target_seqs = [self.sequences[i].data
+                       for i in range(self.targets_size)]
+        read_self_t = np.fromiter(
+            (p if p < self.targets_size else -1 for p in read_pos),
+            np.int64, raw_index)
+        k = max(4, min(16, flags.get_int("RACON_TPU_OVERLAP_K")))
+        if flags.get_bool("RACON_TPU_WARMUP"):
+            # race the chain-arena compile against host seeding/matching
+            est_len = max((len(s) for s in read_seqs), default=0)
+            overlap_seed.warmup_async(est_len, len(read_seqs))
+            chain_ops.warmup_async(max(1, est_len // 8), raw_index, k=k)
+
+        state = {"est_pairs": 0}
+
+        def flush_run(run: List[Overlap]) -> List[Overlap]:
+            # one consecutive same-q_id run through the
+            # _filter_overlaps sweep (error/self drop; C mode keeps the
+            # longest, later overlap winning ties)
+            kept = [o for o in run
+                    if o.error <= self.error_threshold
+                    and o.q_id != o.t_id]
+            if kept and self.type == PolisherType.C:
+                best = kept[0]
+                for o in kept[1:]:
+                    if o.length >= best.length:
+                        best = o
+                kept = [best]
+            for o in kept:
+                if o.strand:
+                    has_reverse[o.q_id] = True
+                    # align reads the revcomp span before the deferred
+                    # full transmute runs — materialize it at flush
+                    # (idempotent; the transmute pass reuses it)
+                    self.sequences[o.q_id].create_reverse_complement()
+                else:
+                    has_data[o.q_id] = True
+                state["est_pairs"] += o.length // self.window_length + 1
+            return kept
+
+        def batches():
+            buf: List[Overlap] = []
+            run: List[Overlap] = []
+            with obs.span("overlap.filter"):
+                pass  # span parity with the barrier path (work is inline)
+            # graftlint: disable=jit-shape-hazard (k is a run-constant flag value clipped to 4..16 — one compile per run)
+            for rows in chain_ops.iter_overlap_groups(
+                    read_seqs, target_seqs, read_self_t, k=k):
+                for i in range(rows["q_ord"].size):
+                    q = int(rows["q_ord"][i])
+                    t = int(rows["t_idx"][i])
+                    o = Overlap.from_paf(
+                        self.sequences[read_pos[q]].name,
+                        len(read_seqs[q]),
+                        int(rows["q_begin"][i]), int(rows["q_end"][i]),
+                        "-" if int(rows["strand"][i]) else "+",
+                        self.sequences[t].name, len(target_seqs[t]),
+                        int(rows["t_begin"][i]), int(rows["t_end"][i]))
+                    o.transmute(self.sequences, name_to_id, id_to_id)
+                    if not o.is_valid:
+                        continue
+                    if run and o.q_id != run[-1].q_id:
+                        buf.extend(flush_run(run))
+                        run.clear()
+                    run.append(o)
+                if len(buf) >= 512:
+                    yield buf
+                    buf = []
+            buf.extend(flush_run(run))
+            # every overlap is known now but alignment is still
+            # draining — the consensus compile hides under it exactly
+            # like the barrier path's placement before align
+            self._kick_consensus_warmup(state["est_pairs"])
+            if buf:
+                yield buf
+
+        overlaps: List[Overlap] = []
+        # graftlint: disable=lock-discipline (one builder thread per polisher; see _initialize_core)
+        self.timings["parse_s"] = round(time.perf_counter() - t_parse, 3)
+        self.find_overlap_breaking_points(overlaps, feed=batches())
+        if not overlaps:
+            raise ValueError("empty overlap set")
+        self.logger.log("[racon_tpu::Polisher::initialize] generated "
+                        "overlaps (first-party overlapper, streamed)")
+        self.logger.log()
+        self._transmute_all(has_name, has_data, has_reverse)
+        return overlaps
+
     def _filter_overlaps(self, overlaps: List[Overlap]) -> List[Overlap]:
         """Per-query group filter (``polisher.cpp:283-307``): drop
         error > threshold and self overlaps; for contig polishing keep only
@@ -420,16 +546,33 @@ class Polisher:
             i = j
         return result
 
-    def find_overlap_breaking_points(self, overlaps: List[Overlap]) -> None:
+    def find_overlap_breaking_points(self, overlaps: List[Overlap],
+                                     feed=None) -> None:
         """Align CIGAR-less overlaps (batched through the aligner backend —
         reference: ``polisher.cpp:461-483`` / ``cudapolisher.cpp:86-200``)
         then derive per-window breaking points, advancing the reference's
         20-bin progress bar (``polisher.cpp:475-481``). Host-side CIGARs
         (SAM input, host aligner output) decode to columnar rows in one
-        native thread-pool batch instead of per-overlap Python walks."""
+        native thread-pool batch instead of per-overlap Python walks.
+
+        ``feed`` (the streaming overlap→align handoff) is an iterator of
+        filtered, transmuted ``Overlap`` batches still being produced by
+        the chain stream: each batch is appended to ``overlaps`` and fed
+        to the align session as it arrives, so overlap generation for
+        later query groups runs under the alignment of earlier ones. A
+        backend without a streaming session drains the feed first and
+        takes the barrier path — same bytes either way."""
         log = self.logger
         t_align = time.perf_counter()
         msg = "[racon_tpu::Polisher::initialize] aligning overlaps"
+        if feed is not None and not (
+                getattr(self.aligner, "wants_full_stream", False)
+                and getattr(self.aligner, "bp_stream", None) is not None):
+            # host/sessionless aligner: nothing to pipeline into — drain
+            # the producer, then run the phase exactly as barriered
+            for batch in feed:
+                overlaps.extend(batch)
+            feed = None
         need = [o for o in overlaps
                 if not o.cigar and o.breaking_points is None]
         # dispatch-vs-fetch attribution (round 17): the round-11 span
@@ -455,7 +598,10 @@ class Polisher:
                     "align", prefixes=("racon_tpu.ops.nw",
                                        "racon_tpu.ops.pallas_nw",
                                        "racon_tpu.parallel")):
-            self._align_need(need, log, msg)
+            if feed is not None:
+                self._align_feed(feed, overlaps, need, log, msg)
+            else:
+                self._align_need(need, log, msg)
         self.timings["align_s"] = round(time.perf_counter() - t_align, 3)
         self.timings["align_dispatch_s"] = round(
             metrics.timer_s(scope + "align.dispatch") - t_disp0, 3)
@@ -486,6 +632,41 @@ class Polisher:
         self.timings["bp_decode_s"] = round(
             time.perf_counter() - t_decode, 3)
         self.logger.log("[racon_tpu::Polisher::initialize] aligned overlaps")
+
+    def _align_feed(self, feed, overlaps, need, log, msg) -> None:
+        """The streaming half of the overlap→align handoff: drain
+        filtered overlap batches off the chain stream and feed the
+        round-17 align session as they arrive. The session packs and
+        dispatches asynchronously, so the chain stream's device DP and
+        host filtering for query group N+1 run while group N's windows
+        align; ``overlap_feed_s`` records the producer wall that hid
+        under the phase."""
+        sess = self.aligner.bp_stream(
+            self.window_length, total=len(need),
+            progress=lambda d, t: log.bar_to(msg, d, t),
+            resident=self._resident)
+        feed_wall = 0.0
+        t0 = time.perf_counter()
+        for batch in feed:
+            feed_wall += time.perf_counter() - t0
+            overlaps.extend(batch)
+            part = [o for o in batch
+                    if not o.cigar and o.breaking_points is None]
+            if part:
+                need.extend(part)
+                pairs = [(o.query_span_bytes(self.sequences),
+                          o.target_span_bytes(self.sequences))
+                         for o in part]
+                metas = [(o.t_begin,
+                          o.q_length - o.q_end if o.strand else o.q_begin)
+                         for o in part]
+                sess.feed(pairs, metas, [o.error for o in part])
+            t0 = time.perf_counter()
+        for o, bp in zip(need, sess.finish()):
+            o.breaking_points = bp
+        # graftlint: disable=lock-discipline (one builder thread per polisher; see _initialize_core)
+        self.timings["overlap_feed_s"] = round(feed_wall, 3)
+        metrics.add_time("overlap.stream_feed", feed_wall)
 
     def _align_need(self, need, log, msg) -> None:
         """The backend-dispatch half of breaking-point alignment (split

@@ -43,11 +43,12 @@ def retrace_summary(scope: str = "") -> str:
 
 
 def pack_summary_str(scope: str = "") -> str:
-    """Real packing occupancy of the consensus pair arenas (round 10)
-    and the aligner wavefront arenas (round 17): occupied/total lanes,
-    mean windows per dispatched group and align chunk count, derived
-    from the registry counters (``-`` before any launch); ``scope``
-    renders one service job's numbers."""
+    """Real packing occupancy of the consensus pair arenas (round 10),
+    the aligner wavefront arenas (round 17), and the overlap chain
+    arenas (round 21, ``o:``): occupied/total lanes, mean windows per
+    dispatched group and align/chain chunk counts, derived from the
+    registry counters (``-`` before any launch); ``scope`` renders one
+    service job's numbers."""
     pack = metrics.pack_summary(scope)
     parts = []
     if pack["groups"]:
@@ -57,6 +58,11 @@ def pack_summary_str(scope: str = "") -> str:
     if pack["align_chunks"]:
         parts.append(f"a:{pack['align_pack_efficiency']:.2f}eff,"
                      f"{pack['align_chunks']}c")
+    o_total = metrics.counter(scope + "overlap.lanes_total")
+    if o_total:
+        o_eff = metrics.counter(scope + "overlap.lanes_occupied") \
+            / o_total
+        parts.append(f"o:{o_eff:.2f}eff")
     return ";".join(parts) if parts else "-"
 
 

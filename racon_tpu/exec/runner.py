@@ -424,6 +424,21 @@ class ShardRunner:
                     self.sequences, self.target_sequences, auto_paf,
                     self.type, self.error_threshold)
             self.overlaps = auto_paf
+            # overlap occupancy + cache telemetry (round 21): surface
+            # the chain-arena fill and target-table reuse the run just
+            # paid for, so a badly-packed or cache-cold overlap phase
+            # is visible at the top of the log, not only in the report
+            o_total = metrics.counter("overlap.lanes_total")
+            if o_total:
+                _eprint(
+                    f"overlap pack: "
+                    f"{metrics.counter('overlap.lanes_occupied') / o_total:.2f}eff "
+                    f"({metrics.counter('overlap.chunks')} chunks), "
+                    f"table cache "
+                    f"{metrics.counter('overlap.cache_hits')}h/"
+                    f"{metrics.counter('overlap.cache_misses')}m, "
+                    f"{metrics.counter('overlap.join_bailouts')} "
+                    f"join bailout(s)")
         else:
             _eprint(f"indexing {os.path.basename(self.overlaps)} / "
                     f"{os.path.basename(self.sequences)} "

@@ -258,6 +258,25 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Minimum chained seeds for an overlapper candidate pair to "
          "emit an overlap row (pairs and chains below it count as "
          "chains_dropped)."),
+    Flag("RACON_TPU_OVERLAP_DEVICE_JOIN", "1", "bool",
+         "Device-resident seed join: sort both minimizer tables once "
+         "on device and run the read-to-target searchsorted join + "
+         "counted frequency capping as jit'd kernels (byte-identical "
+         "to the host join; set 0 to force the numpy match_seeds "
+         "oracle for A/B measurement)."),
+    Flag("RACON_TPU_OVERLAP_RAGGED", "1", "bool",
+         "Ragged overlap occupancy: chain batches greedy-fill a fixed "
+         "lane arena by per-pair seed-count cost with double-buffered "
+         "dispatch/fetch (_ChainStream), and chained overlap rows "
+         "stream per query group into the align session instead of "
+         "phase-barriering (byte-identical either way; set 0 to force "
+         "the bucketed barrier path for A/B measurement)."),
+    Flag("RACON_TPU_OVERLAP_CACHE", "1", "bool",
+         "Target seed-table cache: key the target minimizer table by "
+         "(content fingerprint, k, w) and reuse it across shards of "
+         "one run and across serve jobs on the same target set "
+         "(hits/misses counted in the run report's overlap section "
+         "and credited to the dataflow bytes ledger)."),
     # -------------------------------------------------------- tests, bench
     Flag("RACON_TPU_SLOW", "0", "bool",
          "Enable the slow (tier-2) test set."),

@@ -289,14 +289,17 @@ def dataflow_summary(scope: str = "") -> Dict[str, Number]:
 
 def overlap_summary(scope: str = "") -> Dict[str, Number]:
     """The first-party overlapper accounting the run report's
-    ``overlap`` section (schema v9) embeds: the overlap source
+    ``overlap`` section (schema v10) embeds: the overlap source
     (``auto`` when the in-process minimizer+chain overlapper generated
     the rows — the ``overlap.mode_auto`` gauge — else ``paf`` for
     precomputed-file runs, where every other key is legitimately
     zero), table/candidate volume, the frequency-cap and chain
     keep/drop accounting (capped buckets are counted, never silent),
-    and the seed/chain dispatch-vs-fetch split from the obs span
-    timers.  ``scope`` reads one job's numbers."""
+    the seed/chain/join dispatch-vs-fetch split from the obs span
+    timers, and — new in v10 — the ragged chain-arena occupancy
+    (``lanes_occupied/lanes_total/chunks``), the device-join bail-out
+    count, and the target-table cache hit/miss accounting.  ``scope``
+    reads one job's numbers."""
     with _lock:
         return {
             "mode": ("auto"
@@ -312,10 +315,25 @@ def overlap_summary(scope: str = "") -> Dict[str, Number]:
                 scope + "overlap.chains_kept", 0),
             "chains_dropped": _counters.get(
                 scope + "overlap.chains_dropped", 0),
+            "lanes_occupied": _counters.get(
+                scope + "overlap.lanes_occupied", 0),
+            "lanes_total": _counters.get(
+                scope + "overlap.lanes_total", 0),
+            "chunks": _counters.get(scope + "overlap.chunks", 0),
+            "join_bailouts": _counters.get(
+                scope + "overlap.join_bailouts", 0),
+            "cache_hits": _counters.get(
+                scope + "overlap.cache_hits", 0),
+            "cache_misses": _counters.get(
+                scope + "overlap.cache_misses", 0),
             "seed_dispatch_s": round(_timers.get(
                 scope + "overlap.seed.dispatch", 0.0), 3),
             "seed_fetch_s": round(_timers.get(
                 scope + "overlap.seed.fetch", 0.0), 3),
+            "join_dispatch_s": round(_timers.get(
+                scope + "overlap.join.dispatch", 0.0), 3),
+            "join_fetch_s": round(_timers.get(
+                scope + "overlap.join.fetch", 0.0), 3),
             "chain_dispatch_s": round(_timers.get(
                 scope + "overlap.chain.dispatch", 0.0), 3),
             "chain_fetch_s": round(_timers.get(

@@ -75,7 +75,15 @@ from . import compilewatch, metrics
 # minimizer-table and candidate-pair volume, frequency-capped bucket
 # and chain keep/drop counts, and the seed/chain dispatch-vs-fetch
 # seconds from the ``overlap.seed.*``/``overlap.chain.*`` span timers.
-SCHEMA_VERSION = 9
+# v10 (round 21): the "overlap" section grew required keys for the
+# overlap-occupancy work — ragged chain-arena occupancy
+# ("lanes_occupied"/"lanes_total"/"chunks", the align/consensus pack
+# parity), the device seed-join dispatch-vs-fetch seconds
+# ("join_dispatch_s"/"join_fetch_s" from the ``overlap.join.*`` span
+# timers) and its counted bail-outs ("join_bailouts" — the host-oracle
+# ladder, never silent), and the target seed-table cache accounting
+# ("cache_hits"/"cache_misses", RACON_TPU_OVERLAP_CACHE).
+SCHEMA_VERSION = 10
 
 KINDS = ("cli", "exec", "job")
 
@@ -98,7 +106,7 @@ _TOP = {
     "recovery": (dict, True),           # crash-safe serving counters
     "compiles": (dict, True),           # XLA compile attribution (v7)
     "dataflow": (dict, True),           # resident-dataflow bytes (v8)
-    "overlap": (dict, True),            # first-party overlapper (v9)
+    "overlap": (dict, True),            # first-party overlapper (v9/v10)
     "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
@@ -120,9 +128,11 @@ _DATAFLOW_KEYS = ("resident", "bytes_fetched", "bytes_avoided",
                   "lanes_device_groups", "ins_overflow_windows")
 _OVERLAP_NUM_KEYS = ("minimizers", "candidate_pairs",
                      "freq_capped_buckets", "chains_kept",
-                     "chains_dropped", "seed_dispatch_s",
-                     "seed_fetch_s", "chain_dispatch_s",
-                     "chain_fetch_s")
+                     "chains_dropped", "lanes_occupied", "lanes_total",
+                     "chunks", "join_bailouts", "cache_hits",
+                     "cache_misses", "seed_dispatch_s",
+                     "seed_fetch_s", "join_dispatch_s", "join_fetch_s",
+                     "chain_dispatch_s", "chain_fetch_s")
 _OVERLAP_MODES = ("auto", "paf")
 _COMPILE_EVENT_STR_KEYS = ("fn", "signature", "phase")
 
@@ -219,10 +229,12 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         # avoided, host-fallback pair count and per-window insertion-
         # overflow attribution — all zeros with the flag off
         "dataflow": metrics.dataflow_summary(scope),
-        # first-party overlapper accounting (round 20, schema v9):
-        # overlap source, table/candidate volume, freq-cap and chain
-        # keep/drop counts, seed/chain dispatch-vs-fetch seconds —
-        # mode "paf" with zeros for precomputed-overlap runs
+        # first-party overlapper accounting (round 20 v9, extended
+        # round 21 v10): overlap source, table/candidate volume,
+        # freq-cap and chain keep/drop counts, chain-arena occupancy,
+        # seed/join/chain dispatch-vs-fetch seconds, join bail-outs
+        # and target-table cache hits — mode "paf" with zeros for
+        # precomputed-overlap runs
         "overlap": metrics.overlap_summary(scope),
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and

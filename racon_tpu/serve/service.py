@@ -402,6 +402,21 @@ class PolishServer:
                 # shape-deduped in the engine, so repeats are free
                 queued_new |= awarm(8 * wl, max(1, est_pairs // 8),
                                     window_length=wl) is not None
+        if parsers.is_auto_overlaps(spec["overlaps"]):
+            # --overlaps auto job: the overlapper's seed + chain-arena
+            # kernels are process-global (module jit caches, not
+            # per-slot engines) — warm them once with the job's implied
+            # read geometry (the ~8-windows-per-read profile above),
+            # shape-deduped inside each module so repeats are free
+            from ..ops import chain as chain_ops
+            from ..ops import overlap_seed
+            est_len = 8 * wl
+            est_reads = max(1, read_bases // est_len)
+            k = max(4, min(16, flags.get_int("RACON_TPU_OVERLAP_K")))
+            queued_new |= overlap_seed.warmup_async(
+                est_len, est_reads) is not None
+            queued_new |= chain_ops.warmup_async(
+                max(1, est_len // 8), est_reads, k=k) is not None
         return queued_new
 
     # --------------------------------------------------------- admission

@@ -318,19 +318,23 @@ def test_report_v8_requires_dataflow_section():
     metrics.clear("job.df1.")
 
 
-def test_report_v9_requires_overlap_section():
-    """Schema v9: the first-party overlapper accounting section is
+def test_report_v10_requires_overlap_section():
+    """Schema v10: the first-party overlapper accounting section is
     required — mode 'paf' with zeros for precomputed-overlap runs,
-    mode 'auto' with the seed/match/chain numbers when the in-process
-    overlapper generated the rows — and validated key-by-key."""
+    mode 'auto' with the seed/join/chain numbers when the in-process
+    overlapper generated the rows — and validated key-by-key,
+    including the round-21 occupancy/join/cache keys."""
     metrics.clear("overlap.")
     rep = report.build_report("cli")
     assert report.validate_report(rep) == []
     ov = rep["overlap"]
     assert ov["mode"] == "paf"
     for key in ("minimizers", "candidate_pairs", "freq_capped_buckets",
-                "chains_kept", "chains_dropped", "seed_dispatch_s",
-                "seed_fetch_s", "chain_dispatch_s", "chain_fetch_s"):
+                "chains_kept", "chains_dropped", "lanes_occupied",
+                "lanes_total", "chunks", "join_bailouts", "cache_hits",
+                "cache_misses", "seed_dispatch_s", "seed_fetch_s",
+                "join_dispatch_s", "join_fetch_s", "chain_dispatch_s",
+                "chain_fetch_s"):
         assert ov[key] == 0, (key, ov)
     broken = dict(rep)
     del broken["overlap"]
@@ -342,6 +346,13 @@ def test_report_v9_requires_overlap_section():
     bad = dict(rep, overlap={k: v for k, v in ov.items()
                              if k != "minimizers"})
     assert any("minimizers" in e for e in report.validate_report(bad))
+    # the v10 keys are required, not merely emitted
+    for v10_key in ("lanes_total", "join_bailouts", "cache_hits",
+                    "join_dispatch_s"):
+        bad = dict(rep, overlap={k: v for k, v in ov.items()
+                                 if k != v10_key})
+        assert any(v10_key in e for e in report.validate_report(bad)), \
+            v10_key
 
     # an auto run's numbers flow through (scoped, like a job report)
     metrics.set_scope("job.ov1.")
@@ -352,7 +363,15 @@ def test_report_v9_requires_overlap_section():
         metrics.inc("overlap.freq_capped_buckets", 7)
         metrics.inc("overlap.chains_kept", 40)
         metrics.inc("overlap.chains_dropped", 16)
+        metrics.inc("overlap.lanes_occupied", 900)
+        metrics.inc("overlap.lanes_total", 1024)
+        metrics.inc("overlap.chunks", 3)
+        metrics.inc("overlap.join_bailouts", 1)
+        metrics.inc("overlap.cache_hits", 2)
+        metrics.inc("overlap.cache_misses", 1)
         metrics.add_time("overlap.seed.dispatch", 0.5)
+        metrics.add_time("overlap.join.dispatch", 0.125)
+        metrics.add_time("overlap.join.fetch", 0.375)
         metrics.add_time("overlap.chain.fetch", 0.25)
     finally:
         metrics.set_scope(None)
@@ -364,7 +383,15 @@ def test_report_v9_requires_overlap_section():
     assert scoped["overlap"]["freq_capped_buckets"] == 7
     assert scoped["overlap"]["chains_kept"] == 40
     assert scoped["overlap"]["chains_dropped"] == 16
+    assert scoped["overlap"]["lanes_occupied"] == 900
+    assert scoped["overlap"]["lanes_total"] == 1024
+    assert scoped["overlap"]["chunks"] == 3
+    assert scoped["overlap"]["join_bailouts"] == 1
+    assert scoped["overlap"]["cache_hits"] == 2
+    assert scoped["overlap"]["cache_misses"] == 1
     assert scoped["overlap"]["seed_dispatch_s"] == 0.5
+    assert scoped["overlap"]["join_dispatch_s"] == 0.125
+    assert scoped["overlap"]["join_fetch_s"] == 0.375
     assert scoped["overlap"]["chain_fetch_s"] == 0.25
     metrics.clear("job.ov1.")
 

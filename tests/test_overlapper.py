@@ -22,6 +22,7 @@ from racon_tpu.exec import ShardRunner
 from racon_tpu.exec.index import build_index_readsonly, write_auto_paf
 from racon_tpu.exec.planner import estimate_job_cost
 from racon_tpu.io import parsers
+from racon_tpu.obs import metrics
 from racon_tpu.ops import chain, overlap_seed
 
 REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
@@ -208,6 +209,183 @@ def test_min_seeds_drop_accounting():
     assert rows_tight["q_ord"].size == 0
 
 
+# ------------------------------------------- stage 1.5: device seed join
+
+def rand_table(rng, n_seqs, n_entries, hash_space):
+    """A synthetic minimizer table with a deliberately tiny hash space
+    (dense cross-table collisions) — deduped on (seq, pos) exactly like
+    ``build_seed_table``, the property that makes the join's 5-tuples
+    unique and the device sort's tie-break freedom harmless."""
+    sid = rng.integers(0, n_seqs, n_entries).astype(np.int32)
+    pos = rng.integers(0, 4000, n_entries).astype(np.int32)
+    order = np.lexsort((pos, sid))
+    sid, pos = sid[order], pos[order]
+    keep = np.ones(sid.size, bool)
+    keep[1:] = (sid[1:] != sid[:-1]) | (pos[1:] != pos[:-1])
+    sid, pos = sid[keep], pos[keep]
+    h = rng.integers(0, hash_space, sid.size).astype(np.uint32)
+    strand = rng.integers(0, 2, sid.size).astype(bool)
+    return h, sid, pos, strand
+
+
+def test_device_join_matches_oracle():
+    """The device seed join (sort kernel + ragged expand kernel)
+    reproduces the numpy ``match_seeds`` oracle exactly — randomized
+    dense tables (collision-heavy hash space), both strands, self-hit
+    suppression, and hot-bucket capping included — with zero bail-outs
+    to the oracle."""
+    rng = np.random.default_rng(31)
+    before = metrics.counter("overlap.join_bailouts")
+    for trial in range(8):
+        n_reads = int(rng.integers(2, 10))
+        n_targets = int(rng.integers(1, 6))
+        hash_space = int(rng.integers(20, 300))
+        max_occ = int(rng.integers(2, 40))
+        rt = rand_table(rng, n_reads, int(rng.integers(50, 600)),
+                        hash_space)
+        tt = rand_table(rng, n_targets, int(rng.integers(50, 600)),
+                        hash_space)
+        self_t = np.where(rng.random(n_reads) < 0.3,
+                          rng.integers(0, n_targets, n_reads),
+                          -1).astype(np.int64)
+        qlens = rng.integers(4100, 6000, n_reads).astype(np.int64)
+        want, capped_w = chain.match_seeds(rt, tt, self_t, qlens,
+                                           k=15, max_occ=max_occ)
+        got, capped_g = chain.join_seeds(rt, tt, self_t, qlens, k=15,
+                                         max_occ=max_occ,
+                                         device_join=True)
+        assert capped_g == capped_w, trial
+        for key in ("q", "t", "rel", "tp", "qc"):
+            assert np.array_equal(np.asarray(got[key], np.int64),
+                                  want[key]), (trial, key)
+    assert metrics.counter("overlap.join_bailouts") == before
+
+
+def test_device_join_resident_layout():
+    """Under ``resident=True`` the join keeps the matched seed
+    coordinates on device (``tp_dev``/``qc_dev``); their valid prefix
+    must equal the oracle's host ``tp``/``qc`` columns."""
+    rng = np.random.default_rng(32)
+    rt = rand_table(rng, 6, 400, 150)
+    tt = rand_table(rng, 3, 400, 150)
+    self_t = np.full(6, -1, np.int64)
+    qlens = np.full(6, 5000, np.int64)
+    want, _ = chain.match_seeds(rt, tt, self_t, qlens, k=15, max_occ=32)
+    got, _ = chain.join_seeds(rt, tt, self_t, qlens, k=15, max_occ=32,
+                              device_join=True, resident=True)
+    assert "tp_dev" in got and "qc_dev" in got and "tp" not in got
+    n = got["q"].size
+    assert n == want["q"].size > 0
+    assert np.array_equal(np.asarray(got["tp_dev"])[:n].astype(np.int64),
+                          want["tp"])
+    assert np.array_equal(np.asarray(got["qc_dev"])[:n].astype(np.int64),
+                          want["qc"])
+
+
+def test_device_join_empty_side_bails_to_oracle():
+    """An empty table on either side takes the counted bail-out rung —
+    the oracle's trivial path, never a kernel launch."""
+    rng = np.random.default_rng(33)
+    rt = rand_table(rng, 4, 200, 100)
+    empty = (np.zeros(0, np.uint32), np.zeros(0, np.int32),
+             np.zeros(0, np.int32), np.zeros(0, bool))
+    before = metrics.counter("overlap.join_bailouts")
+    hits, capped = chain.join_seeds(rt, empty, np.full(4, -1, np.int64),
+                                    np.full(4, 5000, np.int64),
+                                    k=15, max_occ=64, device_join=True)
+    assert hits["q"].size == 0 and capped == 0
+    assert metrics.counter("overlap.join_bailouts") == before + 1
+
+
+# ------------------------------------------- stage 2.5: ragged streaming
+
+def test_chain_stream_feed_batching_invariance():
+    """Per-pair chain rows are invariant to how the stream is fed: one
+    giant batch, pair-at-a-time pumping, and ragged 3-pair batches all
+    yield identical rows for every pair id — the property the
+    streamed/barriered byte-identity contract rests on."""
+    rng = np.random.default_rng(34)
+    target = rand_seq(rng, 6000)
+    reads = [target[i * 400:i * 400 + 1500] for i in range(8)]
+    reads += [revcomp(target[2000:3500]), rand_seq(rng, 900)]
+    rt = overlap_seed.build_seed_table(reads)
+    tt = overlap_seed.build_seed_table([target])
+    self_t = np.full(len(reads), -1, np.int64)
+    qlens = np.fromiter((len(r) for r in reads), np.int64, len(reads))
+    hits, _ = chain.match_seeds(rt, tt, self_t, qlens, k=15, max_occ=64)
+    starts, _, counts = chain._pair_runs(hits)
+    jobs = [(p, int(starts[p]), int(counts[p]))
+            for p in range(starts.size)]
+    assert len(jobs) >= 9
+    outs = []
+    for split in (len(jobs), 1, 3):
+        st = chain._ChainStream(k=15, tp=hits["tp"], qc=hits["qc"])
+        for i, (pid, s0, c) in enumerate(jobs):
+            st.add(pid, s0, c)
+            if (i + 1) % split == 0:
+                st.pump()
+        outs.append(st.finish())
+    for other in outs[1:]:
+        assert set(other) == set(outs[0])
+        for pid in outs[0]:
+            assert other[pid].tolist() == outs[0][pid].tolist()
+
+
+def test_ragged_stream_matches_barrier_rows():
+    """find_overlaps emits identical rows (and PAF bytes) across the
+    2x2 of {ragged stream, phase barrier} x {device join, host join} —
+    the kernel-level half of the acceptance byte-identity matrix; the
+    vectorized PAF writer must match its row-at-a-time oracle on the
+    same rows."""
+    rng = np.random.default_rng(35)
+    target = rand_seq(rng, 9000)
+    reads = [target[500:3200], revcomp(target[2800:6000]),
+             target[5500:8700], rand_seq(rng, 2000),
+             revcomp(target[100:1900])]
+    self_t = np.full(len(reads), -1, np.int64)
+    legs = {}
+    for ragged in (True, False):
+        for dj in (True, False):
+            legs[(ragged, dj)] = chain.find_overlaps(
+                reads, [target], self_t, k=15, w=5,
+                ragged=ragged, device_join=dj)
+    base = legs[(True, True)]
+    assert base["q_ord"].size > 0
+    for key_leg, rows in legs.items():
+        for col in chain._ROW_KEYS:
+            assert np.array_equal(rows[col], base[col]), (key_leg, col)
+    names = [b"r%d" % i for i in range(len(reads))]
+    lens = np.fromiter((len(r) for r in reads), np.int64, len(reads))
+    vec = chain.paf_bytes(base, names, lens, [b"t0"],
+                          np.array([len(target)], np.int64), k=15)
+    oracle = chain.paf_bytes_rowwise(base, names, lens, [b"t0"],
+                                     np.array([len(target)], np.int64),
+                                     k=15)
+    assert vec and vec == oracle
+    assert chain.paf_bytes({key: v[:0] for key, v in base.items()},
+                           names, lens, [b"t0"],
+                           np.array([len(target)], np.int64), k=15) == []
+
+
+def test_warmed_repeat_run_zero_new_compiles():
+    """The serve-job contract: a repeat of an identical overlap run
+    dispatches the chain stream into already-compiled executables —
+    the jit cache must not grow by a single entry on the second run."""
+    rng = np.random.default_rng(36)
+    target = rand_seq(rng, 5000)
+    reads = [target[200:1800], target[2500:4200],
+             revcomp(target[1000:2600])]
+    self_t = np.full(3, -1, np.int64)
+    first = chain.find_overlaps(reads, [target], self_t, k=15, w=5,
+                                ragged=True)
+    before = chain._chain_kernel._cache_size()
+    again = chain.find_overlaps(reads, [target], self_t, k=15, w=5,
+                                ragged=True)
+    assert chain._chain_kernel._cache_size() == before
+    for col in chain._ROW_KEYS:
+        assert np.array_equal(first[col], again[col])
+
+
 # ------------------------------------------------------------- warm-up
 
 def test_warmup_shape_cache():
@@ -225,13 +403,15 @@ def test_warmup_shape_cache():
     assert len(overlap_seed._warmed_shapes) == before + 1
 
     before_c = len(chain._warmed_shapes)
+    ladder = chain._warmup_shapes(24, 5)
+    assert 1 <= len(ladder) <= 4
     th_c = chain.warmup_async(24, 5, k=9)
     assert th_c is not None
     th_c.join(60.0)
     assert not th_c.is_alive()
-    assert len(chain._warmed_shapes) == before_c + 1
+    assert len(chain._warmed_shapes) == before_c + len(ladder)
     assert chain.warmup_async(24, 5, k=9) is None
-    assert len(chain._warmed_shapes) == before_c + 1
+    assert len(chain._warmed_shapes) == before_c + len(ladder)
 
 
 def test_warmup_zero_estimates_skip():
@@ -290,6 +470,26 @@ def test_auto_mode_shards_byte_identical(assembly, tmp_path):
     assert buf.getvalue() == want
     assert summary["n_shards"] == 2
     assert (tmp_path / "work" / "auto_overlaps.paf").stat().st_size > 0
+
+
+def test_auto_mode_flag_matrix_byte_identical(assembly, tmp_path,
+                                              monkeypatch):
+    """The acceptance determinism matrix at the polisher level: the
+    polished FASTA is byte-identical across {device join, host join} x
+    {streaming handoff, barrier} — including a barriered --shards 2 run
+    against the default streamed single-shot."""
+    rp, _, lp = assembly
+    want = auto_single_shot(rp, lp)
+    for dj, rag in (("0", "1"), ("1", "0"), ("0", "0")):
+        monkeypatch.setenv("RACON_TPU_OVERLAP_DEVICE_JOIN", dj)
+        monkeypatch.setenv("RACON_TPU_OVERLAP_RAGGED", rag)
+        assert auto_single_shot(rp, lp) == want, (dj, rag)
+    runner = ShardRunner(str(rp), parsers.AUTO_OVERLAPS, str(lp),
+                         work_dir=str(tmp_path / "work"), n_shards=2,
+                         num_threads=4)
+    buf = io.BytesIO()
+    runner.run(buf)
+    assert buf.getvalue() == want
 
 
 def test_auto_mode_f_mode(assembly):

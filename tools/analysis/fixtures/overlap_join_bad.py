@@ -1,0 +1,32 @@
+"""Seeded device-join-shaped host-transfer-in-jit violations (expect
+3): np.* on traced join intermediates inside the jit'd sort/expand
+kernels — the exact transfers the round-21 device seed join exists to
+eliminate — directly and through the interprocedural ramp helper."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("max_occ",))
+def join_sort_kernel(rh, th, *, max_occ):
+    cnt = (jnp.searchsorted(th, rh, side="right")
+           - jnp.searchsorted(th, rh, side="left"))
+    # BAD: np reduction of the traced per-seed hit counts — concretizes
+    # one batch's join cardinality into the compiled program
+    total = np.sum(cnt)
+    # BAD: np.asarray of the traced offset vector (implicit transfer)
+    offs = np.asarray(jnp.cumsum(cnt))
+    return cnt + total + offs[0] + max_occ
+
+
+def _ramp(e, offs):
+    # BAD: reached with traced arguments from join_expand_kernel
+    return np.searchsorted(offs, e, side="right")
+
+
+@jax.jit
+def join_expand_kernel(offs):
+    e = jnp.arange(offs.shape[0])
+    return _ramp(e, offs)

@@ -1,0 +1,38 @@
+"""Clean device-join twin (expect 0 host-sync-in-hot-loop reported, 1
+suppressed): the double-buffered chain-chunk pipeline fetches through
+the sanctioned ``fetch_global`` primitive only when the in-flight
+budget forces it, with a reasoned pragma on the one deliberate
+per-chunk sync (the arena-overflow probe)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def chain_kernel(ts):
+    return jnp.cumsum(ts, axis=-1)
+
+
+def fetch_global(tree):
+    return jax.device_get(tree)             # ok: sanctioned primitive
+
+
+def pipelined_chunks(chunks, budget=2):
+    inflight = []
+    rows = []
+    for c in chunks:
+        inflight.append(chain_kernel(c))
+        while len(inflight) > budget:
+            host = fetch_global([inflight.pop(0)])  # ok: sanctioned
+            rows.append(np.asarray(host[0]))        # ok: host-side
+    for out in inflight:
+        rows.append(fetch_global([out])[0])         # ok: sanctioned
+    return rows
+
+
+def overflow_probe(chunks):
+    for c in chunks:
+        out = chain_kernel(c)
+        # graftlint: disable=host-sync-in-hot-loop (arena-overflow probe: one deliberate sync per chunk gates the bail-out ladder)
+        out.block_until_ready()
+    return True

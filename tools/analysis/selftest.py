@@ -63,6 +63,12 @@ EXPECT = {
     # discipline overlap_seed.py/chain.py actually use
     "overlap_chain_bad.py": ("jit-shape-hazard", 3, 0),
     "overlap_chain_ok.py": ("warmup-coverage", 0, 1),
+    # round 21: the device seed-join shape — np.* on traced join
+    # intermediates inside the jit'd sort/expand kernels (the transfers
+    # the device join eliminates) vs the double-buffered chain-chunk
+    # pipeline fetching only through the sanctioned primitive
+    "overlap_join_bad.py": ("host-transfer-in-jit", 3, 0),
+    "overlap_join_ok.py": ("host-sync-in-hot-loop", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }
