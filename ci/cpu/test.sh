@@ -7,25 +7,31 @@
 set -e
 cd "$(dirname "$0")/../.."
 # ONE consolidated graftlint gate (fail-fast, cheapest): the linter's
-# fixture-based self-tests, then a single repo-wide run with all 16
+# fixture-based self-tests, then a single repo-wide run with all 21
 # rules — tracer leaks, unguarded SWAR entry points, swallowed
 # exceptions, rogue env flags, host syncs, span discipline, the
 # round-15 concurrency/durability pack (lock-discipline,
 # blocking-under-lock, atomic-write-discipline, thread-lifecycle,
-# scope-discipline) and the round-18 compile-surface pack
+# scope-discipline), the round-18 compile-surface pack
 # (jit-shape-hazard, dtype-drift, jit-in-loop, warmup-coverage,
-# host-transfer-in-jit). Zero unsuppressed findings is a hard gate;
-# the machine-readable findings land in a CI artifact file so rule
-# regressions are diffable across runs. Wall time is recorded so the
-# gate's cost stays visible (budget: < 30 s on this repo).
+# host-transfer-in-jit) and the round-22 contract pack
+# (metric-registry, span-registry, fault-site-registry,
+# schema-coherence, state-transition against racon_tpu/contracts.py).
+# Zero unsuppressed findings is a hard gate; the machine-readable
+# findings land in a CI artifact file so rule regressions are diffable
+# across runs, and --timings echoes the per-rule cost so a budget
+# regression names its rule in the log (budget: < 30 s on this repo).
 lint_t0=$SECONDS
 python -m tools.analysis --selftest
-python -m tools.analysis --quiet --json /tmp/graftlint_findings.json \
+python -m tools.analysis --quiet --timings \
+  --json /tmp/graftlint_findings.json \
   racon_tpu tests tools bench.py
-echo "graftlint gate (selftest + repo-wide, 16 rules): $((SECONDS - lint_t0))s (budget 30s; artifact /tmp/graftlint_findings.json)"
-# the README env-flags table is generated from racon_tpu/flags.py and
-# must not drift
+echo "graftlint gate (selftest + repo-wide, 21 rules): $((SECONDS - lint_t0))s (budget 30s; artifact /tmp/graftlint_findings.json)"
+# the README env-flags table (racon_tpu/flags.py) and the README lint
+# rule table (tools/analysis --rules-md) are generated and must not
+# drift
 python -m racon_tpu.flags --check-readme README.md
+python -m tools.analysis --check-readme README.md
 python -m pytest tests/test_ops_swar.py -q
 # runtime-sanitizer shard: the SWAR parity suite re-runs with shadow
 # execution + canaries armed (every chunk sampled), plus the seeded
@@ -127,6 +133,12 @@ python -m pytest tests/test_obs.py -q
 # end of the resident-service shard — it must trace AFTER that
 # shard's cold-retrace asserts)
 python -m pytest tests/test_compile_surface.py -q
+# contracts shard (fail-fast, round 22): the registry selfcheck, the
+# lifecycle state machines, the v10 validator round-trip over all
+# three report kinds from a real polish (zero validator-defaulted
+# keys among exercised sections), the sanitize exit audit and the
+# analyzer's --rules-md/--changed-only surfaces
+python -m pytest tests/test_contracts.py -q
 # catch-all (every file without a dedicated shard above) runs with the
 # tier-1 slow filter: @pytest.mark.slow tests only execute in the
 # per-file shards that name them, never silently in the budget run
@@ -138,7 +150,8 @@ python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_resident_dataflow.py \
   --ignore=tests/test_serve.py --ignore=tests/test_serve_recovery.py \
   --ignore=tests/test_topology.py --ignore=tests/test_parallel.py \
-  --ignore=tests/test_compile_surface.py --ignore=tests/test_overlapper.py
+  --ignore=tests/test_compile_surface.py --ignore=tests/test_overlapper.py \
+  --ignore=tests/test_contracts.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

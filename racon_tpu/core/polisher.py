@@ -640,7 +640,12 @@ class Polisher:
         dispatches asynchronously, so the chain stream's device DP and
         host filtering for query group N+1 run while group N's windows
         align; ``overlap_feed_s`` records the producer wall that hid
-        under the phase."""
+        under the phase.
+
+        ``bp_stream`` can return None even on a streaming-capable
+        backend (mesh runs, ``RACON_TPU_ALIGN_RAGGED=0``) — then there
+        is no session to pipeline into, so drain the producer and take
+        the barrier path, same as a sessionless backend."""
         sess = self.aligner.bp_stream(
             self.window_length, total=len(need),
             progress=lambda d, t: log.bar_to(msg, d, t),
@@ -654,16 +659,21 @@ class Polisher:
                     if not o.cigar and o.breaking_points is None]
             if part:
                 need.extend(part)
-                pairs = [(o.query_span_bytes(self.sequences),
-                          o.target_span_bytes(self.sequences))
-                         for o in part]
-                metas = [(o.t_begin,
-                          o.q_length - o.q_end if o.strand else o.q_begin)
-                         for o in part]
-                sess.feed(pairs, metas, [o.error for o in part])
+                if sess is not None:
+                    pairs = [(o.query_span_bytes(self.sequences),
+                              o.target_span_bytes(self.sequences))
+                             for o in part]
+                    metas = [(o.t_begin,
+                              o.q_length - o.q_end if o.strand
+                              else o.q_begin)
+                             for o in part]
+                    sess.feed(pairs, metas, [o.error for o in part])
             t0 = time.perf_counter()
-        for o, bp in zip(need, sess.finish()):
-            o.breaking_points = bp
+        if sess is not None:
+            for o, bp in zip(need, sess.finish()):
+                o.breaking_points = bp
+        else:
+            self._align_need(need, log, msg)
         # graftlint: disable=lock-discipline (one builder thread per polisher; see _initialize_core)
         self.timings["overlap_feed_s"] = round(feed_wall, 3)
         metrics.add_time("overlap.stream_feed", feed_wall)

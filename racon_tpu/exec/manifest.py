@@ -43,7 +43,7 @@ import os
 import time
 from typing import Dict, Optional
 
-from .. import faults
+from .. import contracts, faults
 from ..utils.logger import warn
 
 MANIFEST_NAME = "manifest.json"
@@ -54,10 +54,14 @@ REPORT_NAME = "run_report.json"
 STATE_PREFIX = "state_"
 VERSION = 2
 
-DONE = "done"
-QUARANTINED = "quarantined"
-PENDING = "pending"
-RUNNING = "running"
+# shard lifecycle — the SHARD_MACHINE of racon_tpu/contracts.py; the
+# state-transition lint rule checks every `entry["status"]` write
+# against the declared edges (pending->running->{done,quarantined},
+# plus the requeue edges back to pending)
+DONE = contracts.SHARD_DONE
+QUARANTINED = contracts.SHARD_QUARANTINED
+PENDING = contracts.SHARD_PENDING
+RUNNING = contracts.SHARD_RUNNING
 
 
 def fsync_dir(path: str) -> None:

@@ -69,17 +69,17 @@ import zlib
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from . import flags
+from . import contracts, flags
 from .obs import metrics
 
-# ---------------------------------------------------------------- taxonomy
+# ----------------------------------------------------------------- taxonomy
+# the taxonomy itself lives in racon_tpu/contracts.py (one registry,
+# statically gate-checked); these aliases keep call sites readable
 
-CLASS_TRANSIENT = "transient-io"
-CLASS_OOM = "device-oom"
-CLASS_STALL = "stall"
-CLASS_COMPUTE = "deterministic-compute"
+CLASS_TRANSIENT, CLASS_OOM, CLASS_STALL, CLASS_COMPUTE = \
+    contracts.FAULT_CLASSES
 
-CLASSES = (CLASS_TRANSIENT, CLASS_OOM, CLASS_STALL, CLASS_COMPUTE)
+CLASSES = contracts.FAULT_CLASSES
 
 
 class InjectedFault(RuntimeError):
@@ -146,13 +146,12 @@ def backoff_s(base: float, k: int, token: str) -> float:
 
 # --------------------------------------------------------------- injection
 
-KNOWN_SITES = ("consensus.dispatch", "align.dispatch", "align.fetch",
-               "part.write",
-               "manifest.write", "worker.kill", "exec.polish",
-               "serve.polish", "serve.journal", "serve.socket",
-               "serve.slot", "server.kill")
+# declared in racon_tpu/contracts.py; the fault-site-registry lint rule
+# holds every FAULT_SITES entry to a check() call site AND an injecting
+# test, so adding a site here without both halves fails the gate
+KNOWN_SITES = contracts.FAULT_SITES
 
-_KINDS = ("io", "enospc", "oom", "err", "stall", "kill")
+_KINDS = contracts.FAULT_KINDS
 
 LEGACY_MESSAGE = "injected device-engine fault (RACON_TPU_EXEC_FAULT_SHARD)"
 

@@ -43,7 +43,9 @@ from __future__ import annotations
 
 import sys
 import threading
-from typing import Dict, Optional, Union
+from typing import Dict, Optional, Set, Union
+
+from .. import contracts
 
 Number = Union[int, float]
 
@@ -51,13 +53,17 @@ _lock = threading.Lock()
 _counters: Dict[str, Number] = {}
 _gauges: Dict[str, Number] = {}
 _timers: Dict[str, float] = {}
+# every name ever written this process (scope-stripped, survives every
+# clear_*): the RACON_TPU_SANITIZE=1 exit audit diffs this against
+# contracts.METRICS to flag registered-but-never-emitted names
+_seen: Set[str] = set()
 
 # thread-local job scope: a prefix applied to every metric WRITE made
 # by the declaring thread (reads always take explicit names — a reader
 # aggregating per-job numbers passes the scope itself)
 _tls = threading.local()
 
-JOB_SCOPE_ROOT = "job."
+JOB_SCOPE_ROOT = contracts.JOB_SCOPE_ROOT
 
 
 def job_scope(job_id) -> str:
@@ -87,23 +93,34 @@ def _scoped(name: str) -> str:
 
 def inc(name: str, delta: Number = 1) -> None:
     """Add ``delta`` to counter ``name`` (created at 0)."""
-    name = _scoped(name)
+    scoped = _scoped(name)
     with _lock:
-        _counters[name] = _counters.get(name, 0) + delta
+        _seen.add(name)
+        _counters[scoped] = _counters.get(scoped, 0) + delta
 
 
 def set_gauge(name: str, value: Number) -> None:
     """Set gauge ``name`` to ``value`` (last write wins)."""
-    name = _scoped(name)
+    scoped = _scoped(name)
     with _lock:
-        _gauges[name] = value
+        _seen.add(name)
+        _gauges[scoped] = value
 
 
 def add_time(name: str, seconds: float) -> None:
     """Accumulate ``seconds`` onto timer ``name``."""
-    name = _scoped(name)
+    scoped = _scoped(name)
     with _lock:
-        _timers[name] = _timers.get(name, 0.0) + seconds
+        _seen.add(name)
+        _timers[scoped] = _timers.get(scoped, 0.0) + seconds
+
+
+def seen_names() -> Set[str]:
+    """Every metric name written this process (scope-stripped,
+    cumulative across :func:`clear_run`/:func:`clear_job`) — the exit
+    audit's emission record."""
+    with _lock:
+        return set(_seen)
 
 
 def counter(name: str, default: Number = 0) -> Number:
@@ -149,13 +166,10 @@ def clear(prefix: Optional[str] = None) -> None:
 
 # every name a run report / runner summary / heartbeat reads describes
 # ONE run; span timers land keyed by the span name, hence the phase
-# prefixes.  "trace." covers the dropped-events gauge of the run's own
-# ring buffers.
-_RUN_PREFIXES = ("align.", "poa.", "consensus.", "queue.", "retrace.",
-                 "retrace_total.", "swallowed.", "trace.", "parse.",
-                 "overlap.", "transmute", "bp.", "build.", "stitch",
-                 "exec.", "faults.", "lease.", "device.", "compile.",
-                 "dataflow.")
+# prefixes.  The set itself lives in racon_tpu/contracts.py (one
+# registry, statically gate-checked) — this alias keeps existing
+# consumers and tests working.
+_RUN_PREFIXES = contracts.RUN_PREFIXES
 
 
 def clear_run() -> None:

@@ -24,6 +24,7 @@ import sys
 from typing import Dict, List, Optional
 
 from . import compilewatch, metrics
+from .. import contracts
 
 # v2 (round 12): the "faults" section (fault-class / injected-site /
 # lease-event counts) became required and shard rows grew the
@@ -83,11 +84,18 @@ from . import compilewatch, metrics
 # timers) and its counted bail-outs ("join_bailouts" — the host-oracle
 # ladder, never silent), and the target seed-table cache accounting
 # ("cache_hits"/"cache_misses", RACON_TPU_OVERLAP_CACHE).
-SCHEMA_VERSION = 10
+# the schema's key sets (per section, per version) live in
+# racon_tpu/contracts.py — ONE registry shared with the schema-coherence
+# lint rule, so a schema bump is a contracts.py edit the gate enforces
+# in both directions.  This module keeps the VALIDATOR's view: accepted
+# types and requiredness, asserted coherent with the registry below.
+SCHEMA_VERSION = contracts.SCHEMA_VERSION
 
-KINDS = ("cli", "exec", "job")
+KINDS = contracts.REPORT_KINDS
 
 _NUM = (int, float)
+
+_SCHEMA_KEYS = contracts.schema_keys()
 
 # top-level schema: key -> (accepted types, required)
 _TOP = {
@@ -113,27 +121,22 @@ _TOP = {
     "shards": (list, False),            # exec runs: one row per shard
 }
 
-_QUEUE_KEYS = ("depth", "producer_wait_s", "consumer_wait_s", "stall_s")
-_PACK_KEYS = ("pack_efficiency", "pad_fraction", "windows_per_group",
-              "groups", "align_pack_efficiency", "align_pad_fraction",
-              "align_chunks", "align_steps_wasted")
-_RECOVERY_KEYS = ("recovered_jobs", "requeued_jobs",
-                  "served_from_spool", "spool_corrupt",
-                  "journal_replayed", "journal_records",
-                  "journal_compactions", "slot_restarts",
-                  "slot_quarantined")
-_COMPILES_NUM_KEYS = ("total_s", "count", "post_warm", "sealed")
-_DATAFLOW_KEYS = ("resident", "bytes_fetched", "bytes_avoided",
-                  "fallback_pairs", "resident_bailouts",
-                  "lanes_device_groups", "ins_overflow_windows")
-_OVERLAP_NUM_KEYS = ("minimizers", "candidate_pairs",
-                     "freq_capped_buckets", "chains_kept",
-                     "chains_dropped", "lanes_occupied", "lanes_total",
-                     "chunks", "join_bailouts", "cache_hits",
-                     "cache_misses", "seed_dispatch_s",
-                     "seed_fetch_s", "join_dispatch_s", "join_fetch_s",
-                     "chain_dispatch_s", "chain_fetch_s")
-_OVERLAP_MODES = ("auto", "paf")
+# the validator's top-level view and the registry's must be the SAME
+# key set — a bump that touches one side only fails at import, before
+# the lint gate even runs
+assert frozenset(_TOP) == _SCHEMA_KEYS["top"], \
+    "report._TOP drifted from contracts.TOP_KEYS"
+
+_QUEUE_KEYS = tuple(sorted(_SCHEMA_KEYS["queue"]))
+_PACK_KEYS = tuple(sorted(_SCHEMA_KEYS["pack"]))
+_RECOVERY_KEYS = tuple(sorted(_SCHEMA_KEYS["recovery"]))
+# "by_function" (dict) and "events" (list) validate structurally below
+_COMPILES_NUM_KEYS = tuple(sorted(
+    _SCHEMA_KEYS["compiles"] - {"by_function", "events"}))
+_DATAFLOW_KEYS = tuple(sorted(_SCHEMA_KEYS["dataflow"]))
+# "mode" is the one string key of the overlap section
+_OVERLAP_NUM_KEYS = tuple(sorted(_SCHEMA_KEYS["overlap"] - {"mode"}))
+_OVERLAP_MODES = contracts.OVERLAP_MODES
 _COMPILE_EVENT_STR_KEYS = ("fn", "signature", "phase")
 
 # per-shard row schema: key -> (accepted types, required)

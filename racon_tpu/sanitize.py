@@ -53,7 +53,7 @@ import time
 import traceback
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from . import flags
+from . import contracts, flags
 from .obs import metrics
 from .utils.logger import warn
 
@@ -562,3 +562,65 @@ def named_lock(name: str):
     if enabled():
         return WitnessedLock(name)
     return threading.Lock()
+
+
+# ------------------------------------------------------------------------
+# process-exit contract audit (the runtime half of the round-22 contract
+# layer): the static rules prove every EMISSION SITE is registered; this
+# audit reports the other direction at the end of a real run — names the
+# registry promises that the process never actually produced.
+
+
+def contract_audit(stream=None) -> Dict[str, List[str]]:
+    """Diff the contract registry against what the process really
+    emitted: registered metrics no site ever wrote
+    (``never_emitted``), and report keys whose backing metric/span
+    timer (:data:`racon_tpu.contracts.REPORT_BACKING`) never fired —
+    i.e. keys the report carries only because the emitters defaulted
+    them (``defaulted_keys``).  Informational, never fatal: a CLI run
+    legitimately never touches the serve metrics.  Counts land in the
+    ``sanitize.contract_*`` gauges so chaos-soak reports carry them."""
+    seen = metrics.seen_names()
+    audit: Dict[str, List[str]] = {"never_emitted": [], "defaulted_keys": []}
+    if not seen:
+        return audit     # nothing ran — everything would be "missing"
+
+    def emitted(name: str) -> bool:
+        if name in seen:
+            return True
+        return any(s.startswith(name + ".") for s in seen)
+
+    audit["never_emitted"] = sorted(
+        m for m in contracts.METRICS if m not in seen)
+    audit["defaulted_keys"] = sorted(
+        key for key, backing in contracts.REPORT_BACKING.items()
+        if not emitted(backing))
+    stream = stream if stream is not None else sys.stderr
+    ne, dk = audit["never_emitted"], audit["defaulted_keys"]
+    metrics.set_gauge("sanitize.contract_never_emitted", len(ne))
+    metrics.set_gauge("sanitize.contract_defaulted_keys", len(dk))
+    if ne:
+        print(f"[racon_tpu::sanitize] contract audit: "
+              f"{len(ne)} registered metric(s) never emitted this "
+              f"process: {', '.join(ne[:12])}"
+              + (" ..." if len(ne) > 12 else ""), file=stream)
+    if dk:
+        print(f"[racon_tpu::sanitize] contract audit: "
+              f"{len(dk)} report key(s) backed by silent metrics "
+              f"(validator defaults): {', '.join(dk[:12])}"
+              + (" ..." if len(dk) > 12 else ""), file=stream)
+    stream.flush()
+    return audit
+
+
+def _exit_contract_audit() -> None:
+    # armed lazily at exit so a test toggling RACON_TPU_SANITIZE
+    # mid-process still gets/loses the audit correctly
+    if enabled():
+        try:
+            contract_audit()
+        except Exception:  # graftlint: disable=swallowed-exception (exit path: a dead stderr must not mask the real exit status)
+            pass
+
+
+atexit.register(_exit_contract_audit)

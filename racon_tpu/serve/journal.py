@@ -59,7 +59,7 @@ import time
 import zlib
 from typing import List, Optional, Tuple
 
-from .. import faults, sanitize
+from .. import contracts, faults, sanitize
 from ..exec import manifest as mf
 from ..obs import metrics
 from ..obs.report import atomic_write_bytes
@@ -68,13 +68,15 @@ from ..utils.logger import log_swallowed, warn
 JOURNAL_NAME = "journal.jsonl"
 SPOOL_DIR = "spool"
 
-# record types (the "rec" field)
-SUBMITTED = "submitted"
-RUNNING = "running"
-DONE = "done"
-FAILED = "failed"
-CANCELLED = "cancelled"
-COLLECTED = "collected"
+# record types (the "rec" field) — declared in racon_tpu/contracts.py
+# as the JOB_MACHINE vocabulary; the state-transition lint rule rejects
+# appends minting any other record type
+SUBMITTED = contracts.JOB_SUBMITTED
+RUNNING = contracts.JOB_RUNNING
+DONE = contracts.JOB_DONE
+FAILED = contracts.JOB_FAILED
+CANCELLED = contracts.JOB_CANCELLED
+COLLECTED = contracts.JOB_COLLECTED
 
 
 class JobJournal:

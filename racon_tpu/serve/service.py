@@ -72,7 +72,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .. import faults, flags, sanitize
+from .. import contracts, faults, flags, sanitize
 from ..core.polisher import PolisherType, create_polisher
 from ..exec import heartbeat as hb
 from ..exec import lease as lease_mod
@@ -85,12 +85,14 @@ from ..utils.logger import log_swallowed, warn
 from . import protocol
 from .journal import JobJournal
 
-# job states
-QUEUED = "queued"
-RUNNING = "running"
-DONE = "done"
-FAILED = "failed"
-CANCELLED = "cancelled"
+# job states — the JOB_MACHINE of racon_tpu/contracts.py; the
+# state-transition lint rule checks every `job.state = ...` write (and
+# its lexical equality guard, when present) against the declared edges
+QUEUED = contracts.JOB_QUEUED
+RUNNING = contracts.JOB_RUNNING
+DONE = contracts.JOB_DONE
+FAILED = contracts.JOB_FAILED
+CANCELLED = contracts.JOB_CANCELLED
 
 _TERMINAL = (DONE, FAILED, CANCELLED)
 

@@ -445,6 +445,50 @@ def test_append_retry_rolls_back_partial_write(short_tmp, monkeypatch):
     j.close()
 
 
+def test_journal_fault_injection_admission_contract(short_tmp,
+                                                    monkeypatch):
+    """The ``serve.journal`` chaos site, injected end-to-end (the
+    round-22 fault-site-registry rule flagged this as the one site no
+    test injected).  A transient injected blip (``io@1``) is absorbed
+    by the append retry ladder — the admission still succeeds; a
+    persistent deterministic failure (``err*``) rejects the admission
+    with the durable-admission reason, the server keeps serving, and
+    the same idempotency key is reusable once the journal recovers
+    (a FAILED prior is retryable by design)."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    serve_dir = os.path.join(short_tmp, "sd")
+    reads, paf, layout = _assembly(short_tmp, [2200], seed=23)
+    with _Server(short_tmp, num_threads=2,
+                 serve_dir=serve_dir) as server:
+        with ServiceClient(server.socket_path) as c:
+            # transient blip: retried under the journal's own ladder
+            monkeypatch.setenv("RACON_TPU_FAULTS", "serve.journal:io@1")
+            sub = c.submit(_spec(reads, paf, layout), key="k-blip")
+            assert sub["ok"], sub
+            header, payload = c.result(sub["job"], timeout_s=300)
+            assert header["ok"] and payload.startswith(b">ctg0")
+
+            # persistent failure: the job is NOT admitted (write-ahead
+            # admission — no durable `submitted` record, no run)
+            monkeypatch.setenv("RACON_TPU_FAULTS", "serve.journal:err*")
+            rej = c.submit(_spec(reads, paf, layout), key="k-dur")
+            assert not rej["ok"]
+            assert "journal write failed" in rej["error"]
+
+            # the server survived, and the key is reusable now that
+            # the journal accepts writes again
+            monkeypatch.delenv("RACON_TPU_FAULTS")
+            assert c.ping()["ok"]
+            sub2 = c.submit(_spec(reads, paf, layout), key="k-dur")
+            assert sub2["ok"], sub2
+            header2, payload2 = c.result(sub2["job"], timeout_s=300)
+            assert header2["ok"] and payload2 == payload
+    # whatever compaction left behind references only the two ADMITTED
+    # jobs — the rejected attempt never reached the journal
+    recs = JobJournal(serve_dir).replay()
+    assert {r["job"] for r in recs} <= {sub["job"], sub2["job"]}
+
+
 def test_journal_replay_tolerates_torn_tail(short_tmp):
     j = JobJournal(os.path.join(short_tmp, "sd"))
     j.append({"rec": "submitted", "job": "j1", "cost": 1,

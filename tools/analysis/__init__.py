@@ -17,6 +17,11 @@ origin classification of the values reaching them (see
     python -m tools.analysis --list            # rule inventory
     python -m tools.analysis --json PATH       # machine JSON on stdout
     python -m tools.analysis --json out.json PATH   # ...to a CI artifact
+    python -m tools.analysis --changed-only PATH    # git-diff set + import
+                                               # neighbors (CI gate mode)
+    python -m tools.analysis --timings PATH    # per-rule seconds to stderr
+    python -m tools.analysis --rules-md        # README rule table (gated
+                                               # by --check-readme README.md)
 
 Suppression: a finding is silenced by a pragma **with a reason** on the
 finding line or the line above::
@@ -134,28 +139,137 @@ def check_pragma_hygiene(module: Module) -> List[Finding]:
     return out
 
 
+# ------------------------------------------------------- incremental mode
+
+# a change to the analyzer itself or to a registry EVERY rule reads
+# invalidates any incremental skip: fall back to the full run
+_FULL_RUN_TRIGGERS = ("tools/analysis/", "racon_tpu/contracts.py",
+                      "racon_tpu/flags.py")
+
+
+def changed_rels() -> Optional[set]:
+    """Repo-relative ``.py`` files changed vs HEAD (worktree diff +
+    untracked), per git.  None = incremental mode unavailable (no git,
+    or the analyzer/registries themselves changed) — callers fall back
+    to the full run.  Paths come from git, so the caller must run from
+    the repo root (CI does)."""
+    import subprocess
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=30)
+        extra = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or extra.returncode != 0:
+        return None
+    rels = {line.strip()
+            for line in (diff.stdout + extra.stdout).splitlines()
+            if line.strip().endswith(".py")}
+    if any(r.startswith(_FULL_RUN_TRIGGERS) for r in rels):
+        return None
+    return rels
+
+
+def expand_changed(project: Project, changed: set) -> set:
+    """The changed set plus its import neighbors in BOTH directions:
+    modules a changed module imports (its contracts may have moved)
+    and modules importing a changed one (their use sites may have
+    broken).  One hop — the project index the rules consult is still
+    built over the WHOLE tree, so deeper effects (jit taint, lock
+    closures) stay correct; the hop only widens which modules get
+    re-checked."""
+    prov = project.provenance()
+    dotted_to_rel = {d: m.rel for d, m in prov._by_dotted.items()}
+    imports_of = {}
+    for m in project.modules:
+        cands = set()
+        for (mod, member) in prov.imports(m).values():
+            cands.add(mod)
+            if member:
+                cands.add(f"{mod}.{member}")
+        imports_of[m.rel] = cands
+    changed_dotted = {d for d, r in dotted_to_rel.items() if r in changed}
+    out = set(changed)
+    for m in project.modules:
+        if imports_of[m.rel] & changed_dotted:
+            out.add(m.rel)
+    for r in changed:
+        for cand in imports_of.get(r, ()):
+            if cand in dotted_to_rel:
+                out.add(dotted_to_rel[cand])
+    return out
+
+
 def run(paths: Sequence[str],
         rules: Optional[Sequence[Rule]] = None,
-        scoped: bool = True) -> Tuple[List[Finding], List[Finding]]:
+        scoped: bool = True,
+        only: Optional[set] = None,
+        timings: Optional[Dict[str, float]] = None,
+        ) -> Tuple[List[Finding], List[Finding]]:
     """Lint ``paths``; returns (reported, suppressed). ``scoped=False``
     disables per-rule path scoping (the selftest fixtures live outside
-    the rules' production scopes)."""
+    the rules' production scopes).  ``only`` restricts which modules'
+    findings are computed (the full project is still parsed and
+    indexed — incremental mode narrows checking, never the rules'
+    view).  ``timings`` accumulates per-rule wall seconds in place."""
+    import time
     project = load_project(paths)
+    if only is not None:
+        only = expand_changed(project, only)
     rules = list(rules if rules is not None else ALL_RULES)
     reported: List[Finding] = []
     suppressed: List[Finding] = []
     for module in project.modules:
+        if only is not None and module.rel not in only:
+            continue
         found: List[Finding] = []
         for rule in rules:
             if scoped and not rule.applies(module.rel):
                 continue
-            found.extend(rule.check(project, module))
+            if timings is None:
+                found.extend(rule.check(project, module))
+            else:
+                t0 = time.perf_counter()
+                found.extend(rule.check(project, module))
+                timings[rule.name] = (timings.get(rule.name, 0.0)
+                                      + time.perf_counter() - t0)
         rep, sup = apply_pragmas(module, found)
         reported.extend(rep)
         suppressed.extend(sup)
         reported.extend(check_pragma_hygiene(module))
     reported.sort(key=lambda f: (f.rel, f.line, f.rule))
     return reported, suppressed
+
+
+# ------------------------------------------------------- README generation
+
+_TABLE_NOTE = ("<!-- generated by `python -m tools.analysis --rules-md` "
+               "from tools/analysis — do not edit by hand -->")
+
+
+def rules_md() -> str:
+    """The README "Static analysis" rule table, generated from the live
+    rule registry (one row per rule, registration order) — the same
+    generate-and-gate mechanism as the flags table."""
+    lines = [_TABLE_NOTE, "",
+             "| rule | catches |",
+             "| --- | --- |"]
+    for rule in ALL_RULES:
+        lines.append(f"| `{rule.name}` | {rule.blurb} |")
+    return "\n".join(lines) + "\n"
+
+
+def check_readme(path: str) -> bool:
+    """True when ``path`` contains the current generated rule table
+    verbatim (the lint shard runs this so the README cannot drift)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return rules_md() in fh.read()
+    except OSError:
+        return False
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -168,7 +282,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if "--selftest" in argv:
         from .selftest import run_selftest
         return run_selftest()
+    if "--rules-md" in argv:
+        print(rules_md(), end="")
+        return 0
+    if "--check-readme" in argv:
+        i = argv.index("--check-readme")
+        target = (argv[i + 1] if i + 1 < len(argv) else "README.md")
+        if check_readme(target):
+            return 0
+        print("README static-analysis rule table is stale — regenerate "
+              "with `python -m tools.analysis --rules-md` and paste the "
+              "output", file=sys.stderr)
+        return 1
     quiet = "--quiet" in argv
+    changed_only = "--changed-only" in argv
+    want_timings = "--timings" in argv
     as_json = "--json" in argv
     json_path: Optional[str] = None
     if as_json:
@@ -184,13 +312,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
         print("usage: python -m tools.analysis [--selftest|--list|"
-              "--json [FILE.json]] PATH [PATH...]", file=sys.stderr)
+              "--rules-md|--check-readme [README]|--changed-only|"
+              "--timings|--json [FILE.json]] PATH [PATH...]",
+              file=sys.stderr)
         return 2
+    only: Optional[set] = None
+    if changed_only:
+        only = changed_rels()
+        if only is None:
+            print("graftlint: --changed-only unavailable (no git, or "
+                  "the analyzer/registries changed) — full run",
+                  file=sys.stderr)
+        elif not quiet:
+            print(f"graftlint: --changed-only over {len(only)} changed "
+                  f"file(s) + import neighbors", file=sys.stderr)
+    timings: Optional[Dict[str, float]] = {} if want_timings else None
     try:
-        reported, suppressed = run(paths)
+        reported, suppressed = run(paths, only=only, timings=timings)
     except (FileNotFoundError, SyntaxError) as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
+    if timings is not None:
+        for name, secs in sorted(timings.items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"graftlint timing: {name} {secs:.2f}s",
+                  file=sys.stderr)
     if as_json:
         # machine-readable output for CI annotation/aggregation: every
         # finding (reported AND pragma-suppressed, distinguished by the

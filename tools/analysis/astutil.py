@@ -182,6 +182,13 @@ class Project:
         self._taint: Optional[Dict[int, Set[str]]] = None
         self._reachable: Optional[Set[int]] = None
         self._logging: Optional[Set[int]] = None
+        self._provenance: Optional["StringProvenance"] = None
+
+    def provenance(self) -> "StringProvenance":
+        """The cached cross-module string-constant resolver."""
+        if self._provenance is None:
+            self._provenance = StringProvenance(self)
+        return self._provenance
 
     # ------------------------------------------------------------ indexing
 
@@ -868,3 +875,156 @@ def map_call_args(call: ast.Call,
         if kw.arg is not None and kw.arg in valid:
             mapped[kw.arg] = kw.value
     return mapped
+
+
+# ----------------------------------------- string-literal provenance
+
+def fstring_prefix(node: ast.JoinedStr) -> str:
+    """The leading literal text of an f-string (everything before the
+    first interpolation) — a dynamic metric name's checkable prefix."""
+    parts: List[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        else:
+            break
+    return "".join(parts)
+
+
+class StringProvenance:
+    """Cross-module resolution of string-constant provenance: a
+    ``Constant``, a ``Name`` bound by a module-level assignment or a
+    ``from mod import NAME``, or an ``alias.NAME`` attribute whose
+    alias an import statement binds to another project module.  The
+    contract rules use this to see through constant indirection
+    (``entry.update(status=mf.RUNNING)`` resolves to ``"running"``
+    through the manifest module's ``RUNNING = contracts.SHARD_RUNNING``
+    chain) without importing the code under analysis."""
+
+    _MAX_DEPTH = 6
+
+    def __init__(self, project: "Project"):
+        self.project = project
+        self._by_dotted: Dict[str, Module] = {}
+        for m in project.modules:
+            rel = m.rel
+            if rel.endswith("/__init__.py"):
+                name = rel[:-len("/__init__.py")].replace("/", ".")
+            elif rel.endswith(".py"):
+                name = rel[:-3].replace("/", ".")
+            else:
+                continue
+            self._by_dotted[name] = m
+        self._constants: Dict[int, Dict[str, ast.AST]] = {}
+        self._imports: Dict[int, Dict[str, Tuple[str, Optional[str]]]] = {}
+
+    def constants(self, module: Module) -> Dict[str, ast.AST]:
+        """Module-level single-Name assignments (``NAME = <expr>``)."""
+        cached = self._constants.get(id(module))
+        if cached is None:
+            cached = {}
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    cached[node.targets[0].id] = node.value
+            self._constants[id(module)] = cached
+        return cached
+
+    def imports(self, module: Module) -> Dict[str,
+                                              Tuple[str, Optional[str]]]:
+        """Local binding -> (source module dotted name, member name).
+        Member None = the binding IS the module (``import x as m`` /
+        ``from pkg import mod``); else a ``from mod import NAME``."""
+        cached = self._imports.get(id(module))
+        if cached is not None:
+            return cached
+        cached = {}
+        pkg_parts = module.rel.split("/")[:-1]
+        if module.rel.endswith("/__init__.py"):
+            pkg_parts = module.rel.split("/")[:-1]
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        cached[a.asname] = (a.name, None)
+                    else:
+                        cached[a.name.split(".")[0]] = \
+                            (a.name.split(".")[0], None)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                else:
+                    base = []
+                base_name = ".".join(
+                    base + (node.module.split(".") if node.module
+                            else []))
+                for a in node.names:
+                    bind = a.asname or a.name
+                    as_mod = f"{base_name}.{a.name}" if base_name \
+                        else a.name
+                    if as_mod in self._by_dotted:
+                        cached[bind] = (as_mod, None)
+                    else:
+                        cached[bind] = (base_name, a.name)
+        self._imports[id(module)] = cached
+        return cached
+
+    def resolve_str(self, module: Module, expr: ast.AST,
+                    depth: int = 0) -> Optional[str]:
+        """The string value ``expr`` provably holds, else None."""
+        if depth > self._MAX_DEPTH or expr is None:
+            return None
+        if isinstance(expr, ast.Constant):
+            return expr.value if isinstance(expr.value, str) else None
+        if isinstance(expr, ast.Name):
+            bound = self.constants(module).get(expr.id)
+            if bound is not None:
+                return self.resolve_str(module, bound, depth + 1)
+            imp = self.imports(module).get(expr.id)
+            if imp and imp[1] is not None:
+                src = self._by_dotted.get(imp[0])
+                if src is not None:
+                    bound = self.constants(src).get(imp[1])
+                    if bound is not None:
+                        return self.resolve_str(src, bound, depth + 1)
+            return None
+        if isinstance(expr, ast.Attribute):
+            alias = dotted(expr.value)
+            if alias is None:
+                return None
+            imp = self.imports(module).get(alias)
+            target = None
+            if imp and imp[1] is None:
+                target = self._by_dotted.get(imp[0])
+            if target is None:
+                target = self._by_dotted.get(alias)
+            if target is not None:
+                bound = self.constants(target).get(expr.attr)
+                if bound is not None:
+                    return self.resolve_str(target, bound, depth + 1)
+            return None
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = self.resolve_str(module, expr.left, depth + 1)
+            right = self.resolve_str(module, expr.right, depth + 1)
+            if left is not None and right is not None:
+                return left + right
+        return None
+
+    def resolve_str_seq(self, module: Module,
+                        expr: ast.AST) -> Optional[List[str]]:
+        """Every element of a tuple/list literal resolved to strings
+        (None when any element resists — a partial set would make the
+        consuming rule silently blind to the unresolved entries)."""
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in expr.elts:
+                v = self.resolve_str(module, elt)
+                if v is None:
+                    return None
+                out.append(v)
+            return out
+        if isinstance(expr, ast.Call) and dotted(expr.func) in (
+                "frozenset", "set", "tuple", "list") and expr.args:
+            return self.resolve_str_seq(module, expr.args[0])
+        return None

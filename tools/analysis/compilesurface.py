@@ -488,6 +488,7 @@ class JitShapeHazardRule(Rule):
     pragma."""
 
     name = "jit-shape-hazard"
+    blurb = ("an unbounded value (raw length, `len()` of a runtime list) reaching a shape/dtype-determining parameter of a jit root — every distinct value is a separate XLA compile")
 
     def applies(self, rel: str) -> bool:
         return rel.startswith("racon_tpu/") and rel.endswith(".py")
@@ -534,6 +535,7 @@ class DtypeDriftRule(Rule):
     pragma."""
 
     name = "dtype-drift"
+    blurb = ("int16/uint16 SWAR lanes silently promoted to a wider dtype across an op boundary")
     NARROW = {"int16", "uint16"}
     WIDE = {"int32", "uint32", "int64", "uint64"}
     MIXERS = {"where", "minimum", "maximum", "add", "subtract",
@@ -664,6 +666,7 @@ class JitInLoopRule(Rule):
     behaviour) takes a reasoned pragma."""
 
     name = "jit-in-loop"
+    blurb = ("`jax.jit` (or a jit-decorated def) constructed per loop iteration — guaranteed cache miss")
     JIT_CALLS = {"jax.jit", "jit"}
 
     def check(self, project: Project, module: Module) -> List[Finding]:
@@ -719,6 +722,7 @@ class WarmupCoverageRule(Rule):
     reasoned pragma."""
 
     name = "warmup-coverage"
+    blurb = ("a dispatch-path geometry derivation not mirrored by `_warmup_shapes` (an unshared helper, or an inline pow2 loop on either side)")
     WARM_NAME = "_warmup_shapes"
 
     def applies(self, rel: str) -> bool:
@@ -811,6 +815,7 @@ class HostTransferInJitRule(Rule):
     fetch paths — never inside a traced function."""
 
     name = "host-transfer-in-jit"
+    blurb = ("implicit `np.asarray`/`np.*` on a tracer path inside jit-reachable functions")
     NP_PREFIXES = ("np.", "numpy.")
 
     def applies(self, rel: str) -> bool:

@@ -60,6 +60,7 @@ class LockDisciplineRule(Rule):
     reasoned pragma."""
 
     name = "lock-discipline"
+    blurb = ("a shared attribute written from ≥2 execution contexts (thread roots / main path) with no common guarding lock")
     SKIP_METHODS = {"__init__", "__new__", "__post_init__"}
 
     def applies(self, rel: str) -> bool:
@@ -140,6 +141,7 @@ class BlockingUnderLockRule(Rule):
     snapshot writer) takes a reasoned pragma."""
 
     name = "blocking-under-lock"
+    blurb = ("sleep / socket I/O / `subprocess` / fsync / device sync / bounded-queue get-put while a named lock is held (transitively too)")
 
     def applies(self, rel: str) -> bool:
         return rel.startswith("racon_tpu/") and rel.endswith(".py")
@@ -186,6 +188,7 @@ class AtomicWriteDisciplineRule(Rule):
     reasoned pragma."""
 
     name = "atomic-write-discipline"
+    blurb = ("raw write-mode `open()` in the durability-critical packages (tmp→fsync→rename writers allowlisted)")
     WRITE_MODES = ("w", "a", "x")
     APPEND_SYNCERS = ("os.fsync", "append_durable")
 
@@ -290,6 +293,7 @@ class ThreadLifecycleRule(Rule):
     warm-up) takes a reasoned pragma."""
 
     name = "thread-lifecycle"
+    blurb = ("threads started with no join and no stop-event wiring")
 
     def applies(self, rel: str) -> bool:
         return rel.startswith("racon_tpu/") and rel.endswith(".py")
@@ -390,6 +394,7 @@ class ScopeDisciplineRule(Rule):
     the scope string around legitimately."""
 
     name = "scope-discipline"
+    blurb = ("hand-built `job.` metric names bypassing `metrics.job_scope`")
     WRITERS = {"inc", "set_gauge", "add_time", "set_scope", "clear"}
     PREFIX = "job."
 

@@ -1,0 +1,20 @@
+"""Seeded fault-site-registry violations.  Site ``fixture.alpha`` is
+fully wired (check call + injection spec below); ``fixture.beta`` and
+``fixture.delta`` have no faults.check injection point at all;
+``fixture.gamma`` has a check call but no test injects it."""
+
+FAULT_SITES = (
+    "fixture.alpha",
+    "fixture.beta",
+    "fixture.gamma",
+    "fixture.delta",
+)
+
+
+def hot_path(faults):
+    faults.check("fixture.alpha")
+    faults.check("fixture.gamma")
+
+
+def test_alpha_injection(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FAULTS", "fixture.alpha:io_error")

@@ -1,0 +1,18 @@
+"""Clean twin: ``fixture.sigma`` carries both halves of the chaos
+contract; ``fixture.tau`` is checked but intentionally exercised by a
+direct monkeypatch rather than an env spec, so its element carries a
+reasoned pragma."""
+
+FAULT_SITES = (
+    "fixture.sigma",
+    "fixture.tau",  # graftlint: disable=fault-site-registry (exercised via direct monkeypatch of the check hook, not an env spec)
+)
+
+
+def hot_path(faults):
+    faults.check("fixture.sigma")
+    faults.check("fixture.tau")
+
+
+def test_sigma_injection(monkeypatch):
+    monkeypatch.setenv("RACON_TPU_FAULTS", "fixture.sigma:stall")

@@ -1,0 +1,9 @@
+"""Seeded metric-registry violations: a name that breaks the grammar,
+an unregistered static name, and a dynamic name under an unregistered
+prefix."""
+
+
+def emit(metrics, dev):
+    metrics.inc("Bad-Name")                         # grammar violation
+    metrics.set_gauge("totally.unregistered_metric", 1)   # not in METRICS
+    metrics.add_time(f"unknownpfx.{dev}.t_s", 0.5)  # unregistered prefix

@@ -1,0 +1,14 @@
+"""Clean twin: registered static names (direct and via a module
+constant), a dynamic name under a registered prefix, an unresolvable
+name (skipped, not guessed), and one pragma'd intentional stray."""
+
+_CHUNKS = "align.chunks"
+
+
+def emit(metrics, dev, name):
+    metrics.inc(_CHUNKS)
+    metrics.set_gauge("queue.depth", 3)
+    metrics.add_time("queue.consumer_wait_s", 0.1)
+    metrics.inc(f"device.{dev}.fetches")
+    metrics.inc(name)  # unresolvable -> skipped
+    metrics.inc("not.registered.here")  # graftlint: disable=metric-registry (scratch counter for a local perf probe, never reported)

@@ -1,0 +1,23 @@
+"""Seeded schema-coherence violations: ``queue_summary`` emits an
+unknown key and drops a required one; ``dataflow_summary`` drops a
+required key."""
+
+
+def queue_summary():
+    return {
+        "depth": 1,
+        "producer_wait_s": 0.0,
+        "consumer_wait_s": 0.0,
+        "bogus_key": 9,
+    }
+
+
+def dataflow_summary():
+    return {
+        "resident": True,
+        "bytes_fetched": 0,
+        "bytes_avoided": 0,
+        "fallback_pairs": 0,
+        "ins_overflow_windows": 0,
+        "lanes_device_groups": 0,
+    }

@@ -1,0 +1,12 @@
+"""Clean twin: a faithful ``queue_summary`` plus one pragma'd local
+probe key that is stripped before report assembly."""
+
+
+def queue_summary():
+    return {
+        "depth": 1,
+        "producer_wait_s": 0.0,
+        "consumer_wait_s": 0.0,
+        "stall_s": 0.0,
+        "debug_probe": 1,  # graftlint: disable=schema-coherence (local debug probe, stripped before report assembly)
+    }

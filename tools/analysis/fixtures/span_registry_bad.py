@@ -1,0 +1,11 @@
+"""Seeded span-registry violations: three span names not declared in
+contracts.SPANS — each would orphan the report's span-timer reads."""
+
+
+def work(obs, trace, span):
+    with obs.span("totally.unknown"):
+        pass
+    with span("made.up.name"):
+        pass
+    with trace.span("renamed.silently"):
+        pass

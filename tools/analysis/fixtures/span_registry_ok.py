@@ -1,0 +1,15 @@
+"""Clean twin: declared span names (direct and via a module constant),
+an unresolvable name (skipped), and one pragma'd experimental span."""
+
+_FETCH = "align.fetch"
+
+
+def work(obs, span, dynamic):
+    with obs.span("align.dispatch"):
+        pass
+    with span(_FETCH):
+        pass
+    with obs.span(dynamic):  # unresolvable -> skipped
+        pass
+    with obs.span("scratch.probe"):  # graftlint: disable=span-registry (ad-hoc profiling span, timer never read by the report)
+        pass

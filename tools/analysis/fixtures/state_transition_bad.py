@@ -1,0 +1,16 @@
+"""Seeded state-transition violations: an undeclared job edge
+(collected -> running), an undeclared job state, and an unknown
+journal record type."""
+
+
+def resurrect(job):
+    if job.state == "collected":
+        job.state = "running"
+
+
+def corrupt(job):
+    job.state = "zombie"
+
+
+def replay(journal, job_id):
+    journal.append({"rec": "resubmitted", "id": job_id})

@@ -1,0 +1,22 @@
+"""Clean twin: declared shard requeue and promote edges, an unguarded
+declared job write, a declared journal record, and one pragma'd
+experimental state."""
+
+
+def requeue(entry):
+    if entry["status"] == "done":
+        entry["status"] = "pending"
+
+
+def promote(entry):
+    if entry.get("status") == "pending":
+        entry.update(status="running")
+
+
+def schedule(job, journal):
+    job.state = "queued"
+    journal.append({"rec": "done", "id": 1})
+
+
+def pause(job):
+    job.state = "paused"  # graftlint: disable=state-transition (experimental pause state, round-23 lifecycle candidate)

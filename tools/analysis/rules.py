@@ -27,7 +27,10 @@ blocking-under-lock, atomic-write-discipline, thread-lifecycle and
 scope-discipline.  The compile-surface pack (round 18) lives in
 :mod:`tools.analysis.compilesurface` and registers below too:
 jit-shape-hazard, dtype-drift, jit-in-loop, warmup-coverage and
-host-transfer-in-jit — 16 rules total.
+host-transfer-in-jit.  The contract pack (round 22) lives in
+:mod:`tools.analysis.contracts`: metric-registry, span-registry,
+fault-site-registry, schema-coherence and state-transition —
+21 rules total.
 """
 
 from __future__ import annotations
@@ -86,6 +89,7 @@ class TracerLeakRule(Rule):
     bake one traced batch's concrete value into the compiled program."""
 
     name = "tracer-leak"
+    blurb = ("Python control flow / `int()` / `.item()` on traced values in jit-reachable kernels")
     CASTS = {"int", "bool", "float", "complex"}
     PULL_METHODS = {"item", "tolist"}
 
@@ -156,6 +160,7 @@ class SwarGuardRule(Rule):
     why the geometry cannot overflow."""
 
     name = "swar-guard"
+    blurb = ("packed-int16 entry points not dominated by a `swar_fits`-family overflow guard")
     FLAG_PARAMS = {"swar", "use_swar"}
     GUARDS = {"swar_fits", "_swar_choice", "swar_ok", "pallas_swar_ok"}
 
@@ -253,6 +258,7 @@ class SwallowedExceptionRule(Rule):
     safe to swallow."""
 
     name = "swallowed-exception"
+    blurb = ("broad `except` that neither re-raises nor logs")
     BROAD = {"Exception", "BaseException"}
     # calls that transfer control out of the handler like a raise does
     TERMINAL_CALLS = {"pytest.skip", "pytest.fail", "pytest.xfail",
@@ -304,6 +310,7 @@ class EnvFlagRegistryRule(Rule):
     stdlib only) so declarations are checked for real, not by regex."""
 
     name = "env-flag-registry"
+    blurb = ("`RACON_TPU_*` env reads outside `racon_tpu/flags.py`, or of undeclared names")
     ENV_GETTERS = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
     REGISTRY_GETTERS = {"raw", "get_bool", "get_int", "get_float",
                         "get_str"}
@@ -376,6 +383,7 @@ class HostSyncRule(Rule):
     host-side."""
 
     name = "host-sync-in-hot-loop"
+    blurb = ("device->host pulls inside per-chunk loops")
     EXEMPT_FUNCS = {"fetch_global", "to_global"}
     # calls whose results live on device (host pulls of these are syncs)
     DEVICE_PRODUCERS = {"_dispatch", "align_chain", "sharded_align",
@@ -473,6 +481,7 @@ class SpanDisciplineRule(Rule):
     identity probe in a test) takes a reasoned pragma."""
 
     name = "span-discipline"
+    blurb = ("`obs.span(...)` used any way other than directly as a `with` item")
     # dotted call names that create a span (obs.span is the repo idiom;
     # the bare name covers `from racon_tpu.obs import span`)
     SPAN_CALLS = {"obs.span", "span", "trace.span", "obs.trace.span"}
@@ -507,8 +516,9 @@ class SpanDisciplineRule(Rule):
 # names are bound above by the time these lines run)
 from .compilesurface import COMPILE_SURFACE_RULES  # noqa: E402
 from .concurrency import CONCURRENCY_RULES  # noqa: E402
+from .contracts import CONTRACT_RULES  # noqa: E402
 
 ALL_RULES = [TracerLeakRule(), SwarGuardRule(), SwallowedExceptionRule(),
              EnvFlagRegistryRule(), HostSyncRule(), SpanDisciplineRule(),
-             *CONCURRENCY_RULES, *COMPILE_SURFACE_RULES]
+             *CONCURRENCY_RULES, *COMPILE_SURFACE_RULES, *CONTRACT_RULES]
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -69,6 +69,18 @@ EXPECT = {
     # pipeline fetching only through the sanctioned primitive
     "overlap_join_bad.py": ("host-transfer-in-jit", 3, 0),
     "overlap_join_ok.py": ("host-sync-in-hot-loop", 0, 1),
+    # the contract pack (round 22): string-keyed registries and
+    # lifecycle machines checked against racon_tpu/contracts.py
+    "metric_registry_bad.py": ("metric-registry", 3, 0),
+    "metric_registry_ok.py": ("metric-registry", 0, 1),
+    "span_registry_bad.py": ("span-registry", 3, 0),
+    "span_registry_ok.py": ("span-registry", 0, 1),
+    "fault_site_bad.py": ("fault-site-registry", 3, 0),
+    "fault_site_ok.py": ("fault-site-registry", 0, 1),
+    "schema_coherence_bad.py": ("schema-coherence", 3, 0),
+    "schema_coherence_ok.py": ("schema-coherence", 0, 1),
+    "state_transition_bad.py": ("state-transition", 3, 0),
+    "state_transition_ok.py": ("state-transition", 0, 1),
     # pragma hygiene is driver-level: unknown rule names are findings
     "pragma_bad.py": ("pragma", 1, 0),
 }
