@@ -1284,6 +1284,193 @@ def bench_service():
     return out
 
 
+def bench_fleet():
+    """Fleet serving (round 23): a 3-host fleet (three ``--serve
+    --fleet-dir`` subprocesses) behind one ``--gateway``, driven with
+    mixed-tenant open-loop load (``alpha:3`` vs ``beta:1`` under
+    ``RACON_TPU_FLEET_TENANTS``).  Reports per-tenant
+    ``fleet_<tenant>_p50_s``/``p95_s``, the isolation ratio (alpha's
+    p95 under beta contention over alpha's solo p50 — the weighted-
+    fair claim), and migration-to-first-result after a member SIGKILL
+    (the lease-break re-placement path).  Every result — including
+    the post-kill migrated ones — must be byte-identical to the
+    one-shot CLI run.  ``RACON_TPU_BENCH_FLEET=0`` disables."""
+    import os
+    import socket as socket_mod
+    import statistics
+    import subprocess
+    import tempfile
+    import threading
+
+    from racon_tpu import flags as racon_flags
+
+    mbp = racon_flags.get_float("RACON_TPU_BENCH_FLEET")
+    if not mbp:
+        return {}
+    per_tenant = max(2,
+                     racon_flags.get_int("RACON_TPU_BENCH_FLEET_JOBS"))
+    from racon_tpu.serve.client import ServiceClient
+
+    sim_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "simulate.py")
+    out = {}
+    with tempfile.TemporaryDirectory(dir="/tmp") as td:
+        log(f"fleet bench: generating {mbp} Mbp workload...")
+        subprocess.run([sys.executable, sim_py, str(mbp), td,
+                        "--seed", "61"], check=True)
+        reads, paf, draft = (os.path.join(td, n) for n in
+                             ("reads.fastq", "ovl.paf", "draft.fasta"))
+        cache = os.path.join(td, "xla_cache")
+        env = dict(os.environ,
+                   RACON_TPU_COMPILE_CACHE=cache,
+                   RACON_TPU_FLEET_HOST_TTL_S="2.0",
+                   RACON_TPU_FLEET_POLL_S="0.05",
+                   RACON_TPU_FLEET_TENANTS="alpha:3,beta:1")
+        log("fleet bench: one-shot CLI baseline (the byte-identity "
+            "reference)...")
+        want = subprocess.run(
+            [sys.executable, "-m", "racon_tpu", "-t", "2", "-c", "1",
+             "--tpualigner-batches", "1", reads, paf, draft],
+            stdout=subprocess.PIPE, check=True, env=env).stdout
+
+        fleet_dir = os.path.join(td, "fleet")
+        hosts = []
+        gateway = None
+        spec = {"sequences": reads, "overlaps": paf,
+                "target_sequences": draft, "threads": 2}
+        try:
+            for i in range(3):
+                sock = os.path.join(td, f"host{i}.sock")
+                hosts.append((sock, subprocess.Popen(
+                    [sys.executable, "-m", "racon_tpu",
+                     "--serve", sock, "--fleet-dir", fleet_dir,
+                     "-t", "2", "-c", "1", "--tpualigner-batches",
+                     "1"],
+                    env=env, stderr=subprocess.DEVNULL)))
+            # a pre-probed free port: the gateway needs a concrete
+            # HOST:PORT on its command line
+            probe = socket_mod.socket()
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+            probe.close()
+            addr = f"127.0.0.1:{port}"
+            gateway = subprocess.Popen(
+                [sys.executable, "-m", "racon_tpu",
+                 "--gateway", addr, "--fleet-dir", fleet_dir],
+                env=env, stderr=subprocess.DEVNULL)
+            deadline = time.monotonic() + 300
+            while True:
+                if time.monotonic() > deadline or \
+                        gateway.poll() is not None:
+                    raise RuntimeError("fleet did not come up")
+                try:
+                    with ServiceClient(addr, timeout_s=10,
+                                       retries=0) as c:
+                        if c.ping().get("hosts", {}).get("alive",
+                                                         0) >= 3:
+                            break
+                except (OSError, ConnectionError):
+                    pass
+                time.sleep(0.2)
+            log(f"fleet bench: 3 hosts registered behind {addr}")
+
+            def run_jobs(tenant, n, walls, leg, priority=0):
+                def one(idx):
+                    t0 = time.perf_counter()
+                    with ServiceClient(addr, timeout_s=3600) as c:
+                        job = c.submit(
+                            dict(spec, tenant=tenant,
+                                 priority=priority),
+                            key=f"bench-{leg}-{tenant}-{idx}")
+                        assert job.get("ok"), job
+                        header, payload = c.result(job["job"],
+                                                   timeout_s=3600)
+                    assert header.get("ok"), header
+                    assert payload == want, (
+                        f"{leg}/{tenant} job {idx} diverged from the "
+                        f"one-shot CLI output")
+                    walls[idx] = time.perf_counter() - t0
+                threads = [threading.Thread(target=one, args=(i,))
+                           for i in range(n)]
+                for t in threads:
+                    t.start()
+                return threads
+
+            def pctl(walls, q):
+                w = sorted(walls)
+                return w[min(len(w) - 1, int(q * len(w)))]
+
+            # solo leg: alpha alone on an idle fleet (the isolation
+            # denominator) — also warms every host's engine pool so
+            # the mixed leg measures scheduling, not compiles
+            n_solo = min(per_tenant, 6)
+            log(f"fleet bench: solo leg ({n_solo} alpha jobs, idle "
+                f"fleet)...")
+            solo = [0.0] * n_solo
+            for t in run_jobs("alpha", n_solo, solo, "solo"):
+                t.join()
+            solo_p50 = statistics.median(solo)
+
+            # mixed leg: both tenants flood the gateway open-loop
+            log(f"fleet bench: mixed leg ({per_tenant} alpha + "
+                f"{per_tenant} beta open-loop jobs)...")
+            alpha = [0.0] * per_tenant
+            beta = [0.0] * per_tenant
+            pending = run_jobs("alpha", per_tenant, alpha, "mixed") \
+                + run_jobs("beta", per_tenant, beta, "mixed")
+            for t in pending:
+                t.join()
+            isolation = pctl(alpha, 0.95) / max(solo_p50, 1e-9)
+            log(f"fleet bench: alpha p50 {statistics.median(alpha):.2f}s "
+                f"p95 {pctl(alpha, 0.95):.2f}s, beta p50 "
+                f"{statistics.median(beta):.2f}s p95 "
+                f"{pctl(beta, 0.95):.2f}s (solo p50 {solo_p50:.2f}s, "
+                f"isolation x{isolation:.2f})")
+
+            # migration leg: SIGKILL a member with jobs in flight —
+            # the gateway breaks its leases and re-places on survivors
+            log("fleet bench: migration leg (SIGKILL one host under "
+                "load)...")
+            mig = [0.0] * 6
+            pending = run_jobs("alpha", 6, mig, "mig")
+            time.sleep(max(0.5, solo_p50 / 2))
+            t_kill = time.perf_counter()
+            hosts[0][1].kill()
+            for t in pending:
+                t.join()
+            migration_s = time.perf_counter() - t_kill
+            with ServiceClient(addr, timeout_s=60) as c:
+                migrated = int(c.stats().get("migrated", 0))
+            log(f"fleet bench: all 6 in-flight jobs done "
+                f"{migration_s:.2f}s after the kill "
+                f"({migrated} migrated), byte-identical")
+
+            with ServiceClient(addr, timeout_s=60) as c:
+                c.shutdown()
+            gateway.wait(timeout=120)
+        finally:
+            if gateway is not None and gateway.poll() is None:
+                gateway.kill()
+                gateway.wait()
+            for _, proc in hosts:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+        out.update(
+            fleet_mbp=mbp, fleet_jobs_per_tenant=per_tenant,
+            fleet_hosts=3,
+            fleet_solo_p50_s=round(solo_p50, 3),
+            fleet_alpha_p50_s=round(statistics.median(alpha), 3),
+            fleet_alpha_p95_s=round(pctl(alpha, 0.95), 3),
+            fleet_beta_p50_s=round(statistics.median(beta), 3),
+            fleet_beta_p95_s=round(pctl(beta, 0.95), 3),
+            fleet_isolation_ratio=round(isolation, 2),
+            fleet_migration_s=round(migration_s, 3),
+            fleet_migrated_jobs=migrated,
+            fleet_identity="byte-identical")
+    return out
+
+
 def bench_parse():
     """Ingest throughput (VERDICT r3: parse must stay <10% of wall at
     >=100 Mbp inputs): ~100 MB of concatenated λ-phage FASTQ and ~100 MB
@@ -1340,6 +1527,7 @@ def main():
     shard_metrics = bench_shards()
     multichip_metrics = bench_multichip()
     service_metrics = bench_service()
+    fleet_metrics = bench_fleet()
     parse_metrics = bench_parse()
 
     total_bases = sum(len(w.sequences[0]) for w in windows)
@@ -1361,6 +1549,7 @@ def main():
         **shard_metrics,  # streaming shard-runner scaling curve
         **multichip_metrics,  # Mbp/s-vs-chips curve + identity assert
         **service_metrics,  # resident-service p50/p95 + compile fraction
+        **fleet_metrics,  # per-tenant p50/p95 + isolation + migration
         **parse_metrics,
         "device": str(jax.devices()[0]),
     }

@@ -87,9 +87,13 @@ python -m pytest tests/test_faults.py -q
 # worker/lease-keeper/socket-handler thread (and the soaks' SIGKILLed
 # subprocesses), and any cycle reports at exit.  Round 16 added the
 # serve kill/restart soak, so the witness also covers the journal
-# (serve.journal) and supervision locks.
+# (serve.journal) and supervision locks.  Round 23 adds the fleet
+# chaos pair — the preemption drain and the kill-a-host migration
+# soak — so the witness also covers the gateway's fleet.state lock
+# against its placer/collector/beacon threads.
 RACON_TPU_SANITIZE=1 python -m pytest tests/test_faults.py \
-  tests/test_serve.py tests/test_serve_recovery.py -q \
+  tests/test_serve.py tests/test_serve_recovery.py \
+  tests/test_fleet.py -q \
   -k "chaos or racing or concurrent"
 # multi-chip execution shard (fail-fast, round 13): the topology/
 # planner/chip-scheduler suite — get_mesh prefix selection,
@@ -105,6 +109,15 @@ python -m pytest tests/test_topology.py tests/test_parallel.py -q
 # survival, job-scoped metrics disjointness (the clear_run fix) and
 # the warm-path compile-amortization claim on the device engine
 python -m pytest tests/test_serve.py -q
+# fleet-serving shard (fail-fast, round 23): the multi-tenant gateway
+# — newline-JSON protocol parity with serve (submit grows
+# tenant/priority), weighted-fair stride scheduling with per-tenant
+# budgets, lease-backed placement across registered hosts, durable
+# journal accept-before-ack + restart recovery from spool, the
+# fleet.place/gateway.accept fault sites, priority preemption that
+# DRAINS the victim (never kills), and the kill-a-host migration soak
+# with byte-identity against the one-shot CLI
+python -m pytest tests/test_fleet.py -q
 # crash-safe serving shard (fail-fast, round 16): the kill-server
 # chaos soak (SIGKILL mid-batch under RACON_TPU_FAULTS=server.kill,
 # restart from the same --serve-dir — byte-identical results, zero
@@ -134,7 +147,7 @@ python -m pytest tests/test_obs.py -q
 # shard's cold-retrace asserts)
 python -m pytest tests/test_compile_surface.py -q
 # contracts shard (fail-fast, round 22): the registry selfcheck, the
-# lifecycle state machines, the v10 validator round-trip over all
+# lifecycle state machines, the v11 validator round-trip over all
 # three report kinds from a real polish (zero validator-defaulted
 # keys among exercised sections), the sanitize exit audit and the
 # analyzer's --rules-md/--changed-only surfaces
@@ -151,7 +164,7 @@ python -m pytest tests/ -x -q -m "not slow" --ignore=tests/test_ops_swar.py \
   --ignore=tests/test_serve.py --ignore=tests/test_serve_recovery.py \
   --ignore=tests/test_topology.py --ignore=tests/test_parallel.py \
   --ignore=tests/test_compile_surface.py --ignore=tests/test_overlapper.py \
-  --ignore=tests/test_contracts.py
+  --ignore=tests/test_contracts.py --ignore=tests/test_fleet.py
 # native core under ASan/UBSan (bp thread-pool decoder + streaming gzip
 # parser); self-skips when the toolchain lacks the ASan runtime
 bash ci/checks/native_sanitize.sh

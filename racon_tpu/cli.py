@@ -176,6 +176,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "jobs re-run down the crash ladder "
                         "(RACON_TPU_SERVE_DIR is the env equivalent; "
                         "unset = in-memory only)")
+    # fleet serving (racon_tpu.fleet): a TCP gateway places jobs
+    # across registered --serve hosts under per-job leases
+    p.add_argument("--gateway", metavar="HOST:PORT", default=None,
+                   help="run the fleet gateway: a TCP front door "
+                        "speaking the serve protocol verbatim that "
+                        "journals every accepted job durably (same "
+                        "machinery as --serve-dir) before "
+                        "acknowledging, schedules tenants "
+                        "weighted-fair (RACON_TPU_FLEET_TENANTS), and "
+                        "places jobs across the hosts registered in "
+                        "--fleet-dir under per-job leases — a host "
+                        "dead past RACON_TPU_FLEET_HOST_TTL_S has its "
+                        "jobs re-placed on survivors (see README "
+                        "'Fleet serving')")
+    p.add_argument("--fleet-dir", metavar="DIR", default=None,
+                   help="fleet membership + durable gateway state "
+                        "directory: with --serve the host registers a "
+                        "heartbeat beacon under DIR/hosts/ so the "
+                        "gateway can place work on it; with --gateway "
+                        "it holds the fleet journal, result spool, "
+                        "and per-job leases")
+    p.add_argument("--tenant", metavar="NAME", default=None,
+                   help="tenant to submit under (--submit only): the "
+                        "gateway schedules tenants weighted-fair and "
+                        "enforces per-tenant cost budgets "
+                        "(RACON_TPU_FLEET_TENANTS); unset = 'default'")
+    p.add_argument("--priority", metavar="N", type=int, default=None,
+                   help="job priority for --submit (higher first "
+                        "within a tenant; default 0): at the gateway "
+                        "a high-priority job may preempt a running "
+                        "lower-priority one, draining it back to the "
+                        "queue at a ladder boundary — never killing "
+                        "it mid-window")
     # internal: a spawned cooperating worker — adopts the primary's
     # manifest, claims/polishes shards, emits no merged FASTA
     p.add_argument("--exec-secondary", action="store_true",
@@ -354,6 +387,31 @@ def main(argv=None) -> int:
         parser.error("--serve-dir only makes sense with --serve "
                      "(the shard runner's checkpoint directory is "
                      "--shard-dir)")
+    if args.fleet_dir and not (args.serve or args.gateway):
+        parser.error("--fleet-dir only makes sense with --serve (to "
+                     "register the host) or --gateway (to hold the "
+                     "fleet journal and host registry)")
+    if args.gateway:
+        if args.serve or args.submit:
+            parser.error("--gateway is mutually exclusive with "
+                         "--serve and --submit")
+        if args.sequences or args.overlaps or args.target_sequences:
+            parser.error("--gateway takes no positional inputs (jobs "
+                         "submit theirs over the socket)")
+        if not args.fleet_dir:
+            parser.error("--gateway requires --fleet-dir (the fleet "
+                         "journal, host registry, and leases live "
+                         "there)")
+        from .fleet.gateway import Gateway
+        try:
+            gateway = Gateway(args.gateway, args.fleet_dir)
+            return gateway.serve_forever()
+        except KeyboardInterrupt:
+            gateway.shutdown()
+            return 0
+        except (ValueError, RuntimeError, OSError) as e:
+            print(f"[racon_tpu::fleet] error: {e}", file=sys.stderr)
+            return 1
     if args.serve:
         if args.sequences or args.overlaps or args.target_sequences:
             parser.error("--serve takes no positional inputs (jobs "
@@ -380,7 +438,8 @@ def main(argv=None) -> int:
             workers=args.workers if args.workers > 1 else 0,
             budget_bytes=parse_ram(args.serve_budget)
             if args.serve_budget else 0,
-            serve_dir=args.serve_dir)
+            serve_dir=args.serve_dir,
+            fleet_dir=args.fleet_dir)
         try:
             return server.serve_forever()
         except KeyboardInterrupt:

@@ -84,6 +84,11 @@ METRICS: FrozenSet[str] = frozenset((
     # fault taxonomy + injection
     "faults.backpressure_halvings", "faults.injected.exec.polish",
     "faults.part_corrupt", "faults.stall_escalations",
+    # fleet gateway + placement (gateway-process-lifetime, unscoped)
+    "fleet.cost_cache_hits", "fleet.cost_cache_misses",
+    "fleet.hosts_alive", "fleet.hosts_dead", "fleet.migrated",
+    "fleet.placed", "fleet.preempted",
+    "gateway.accepted", "gateway.rejected",
     # lease lifecycle
     "lease.claimed", "lease.expired", "lease.lost", "lease.reclaimed",
     "lease.stale_write_suppressed",
@@ -120,6 +125,7 @@ DYNAMIC_METRIC_PREFIXES: Tuple[str, ...] = (
     "device.",           # device.<ordinal>.shards/.mbp/.polish_s/...
     "faults.",           # faults.<class> taxonomy counts
     "faults.injected.",  # faults.injected.<site>
+    "fleet.tenant.",     # fleet.tenant.<name>.placed/.queued/...
     "retrace.",          # retrace.<phase> per-phase deltas
     "retrace_total.",    # retrace_total.<phase> run accumulators
     "swallowed.",        # swallowed.<context>|<exc-type>
@@ -132,9 +138,10 @@ JOB_SCOPE_ROOT = "job."
 # every name a run report / runner summary / heartbeat reads describes
 # ONE run; span timers land keyed by the span name, hence the phase
 # prefixes ("trace." covers the dropped-events gauge of the run's own
-# ring buffers).  "serve." / "slot." / "sanitize." are deliberately
-# absent: those are server/process-lifetime facts that must survive
-# run boundaries.  "aligner." was the round-22 drift find: the family
+# ring buffers).  "serve." / "slot." / "sanitize." / "fleet." /
+# "gateway." are deliberately absent: those are server/gateway/
+# process-lifetime facts that must survive run boundaries.  "aligner."
+# was the round-22 drift find: the family
 # existed since round 17 but never matched "align." (no dot), so its
 # counters leaked across back-to-back runs in one process.
 RUN_PREFIXES: Tuple[str, ...] = (
@@ -156,6 +163,7 @@ SPANS: FrozenSet[str] = frozenset((
     "consensus", "consensus.feed", "consensus.finish", "consensus.run",
     "exec.extract", "exec.index", "exec.merge", "exec.plan",
     "exec.shard",
+    "fleet.place", "gateway.admit",
     "overlap.chain", "overlap.chain.dispatch", "overlap.chain.fetch",
     "overlap.filter", "overlap.join.dispatch", "overlap.join.fetch",
     "overlap.match", "overlap.seed", "overlap.seed.dispatch",
@@ -175,7 +183,7 @@ FAULT_SITES: Tuple[str, ...] = (
     "consensus.dispatch", "align.dispatch", "align.fetch",
     "part.write", "manifest.write", "worker.kill", "exec.polish",
     "serve.polish", "serve.journal", "serve.socket", "serve.slot",
-    "server.kill",
+    "server.kill", "fleet.place", "gateway.accept",
 )
 
 FAULT_KINDS: Tuple[str, ...] = ("io", "enospc", "oom", "err", "stall",
@@ -186,7 +194,7 @@ FAULT_CLASSES: Tuple[str, ...] = ("transient-io", "device-oom", "stall",
 
 # -------------------------------------------------------- report schema
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 REPORT_KINDS: Tuple[str, ...] = ("cli", "exec", "job")
 
@@ -208,6 +216,7 @@ TOP_KEYS: Dict[str, int] = {
     "compiles": 7,
     "dataflow": 8,
     "overlap": 9,
+    "fleet": 11,
 }
 
 SECTION_KEYS: Dict[str, Dict[str, int]] = {
@@ -247,6 +256,12 @@ SECTION_KEYS: Dict[str, Dict[str, int]] = {
         "join_bailouts": 10, "cache_hits": 10, "cache_misses": 10,
         "join_dispatch_s": 10, "join_fetch_s": 10,
     },
+    "fleet": {
+        "jobs_accepted": 11, "jobs_rejected": 11, "jobs_placed": 11,
+        "jobs_migrated": 11, "jobs_preempted": 11,
+        "hosts_alive": 11, "hosts_dead": 11,
+        "cost_cache_hits": 11, "cost_cache_misses": 11,
+    },
 }
 
 # schema keys REMOVED at a version (key -> (section, removed_in));
@@ -282,6 +297,7 @@ SECTION_EMITTERS: Dict[str, Tuple[str, str]] = {
     "compiles": ("racon_tpu/obs/compilewatch.py", "summary"),
     "dataflow": ("racon_tpu/obs/metrics.py", "dataflow_summary"),
     "overlap": ("racon_tpu/obs/metrics.py", "overlap_summary"),
+    "fleet": ("racon_tpu/obs/metrics.py", "fleet_summary"),
 }
 
 # report key -> the metric whose emission backs it ("section.key" ->
@@ -341,6 +357,15 @@ REPORT_BACKING: Dict[str, str] = {
     "overlap.join_fetch_s": "overlap.join.fetch",
     "overlap.chain_dispatch_s": "overlap.chain.dispatch",
     "overlap.chain_fetch_s": "overlap.chain.fetch",
+    "fleet.jobs_accepted": "gateway.accepted",
+    "fleet.jobs_rejected": "gateway.rejected",
+    "fleet.jobs_placed": "fleet.placed",
+    "fleet.jobs_migrated": "fleet.migrated",
+    "fleet.jobs_preempted": "fleet.preempted",
+    "fleet.hosts_alive": "fleet.hosts_alive",
+    "fleet.hosts_dead": "fleet.hosts_dead",
+    "fleet.cost_cache_hits": "fleet.cost_cache_hits",
+    "fleet.cost_cache_misses": "fleet.cost_cache_misses",
 }
 
 # -------------------------------------------------------- state machines
@@ -457,8 +482,67 @@ LEASE_MACHINE = StateMachine(
     initial=("free",),
 )
 
+# the fleet-level (gateway's-eye) job lifecycle.  A job is "accepted"
+# once its admission record is durably journaled, "queued" in its
+# tenant's FIFO, "placed" while an incarnation runs on a member host.
+# placed->queued is the drain edge shared by preemption (a higher
+# priority job needs the host) and migration (the host went silent
+# past TTL) — the job re-enters its tenant queue and is re-placed
+# under a NEW incarnation key.  done->collected retires the job once
+# its one-fetch payload streamed to a client (mirrors the serve
+# retention contract).
+TENANT_ACCEPTED = "accepted"
+TENANT_QUEUED = "queued"
+TENANT_PLACED = "placed"
+TENANT_DONE = "done"
+TENANT_FAILED = "failed"
+TENANT_CANCELLED = "cancelled"
+TENANT_COLLECTED = "collected"
+
+TENANT_MACHINE = StateMachine(
+    "tenant",
+    states=(TENANT_ACCEPTED, TENANT_QUEUED, TENANT_PLACED, TENANT_DONE,
+            TENANT_FAILED, TENANT_CANCELLED, TENANT_COLLECTED),
+    edges=(
+        (TENANT_ACCEPTED, TENANT_QUEUED),
+        (TENANT_ACCEPTED, TENANT_FAILED),
+        (TENANT_QUEUED, TENANT_PLACED), (TENANT_QUEUED, TENANT_FAILED),
+        (TENANT_QUEUED, TENANT_CANCELLED),
+        (TENANT_PLACED, TENANT_QUEUED),   # preempt / migrate drain
+        (TENANT_PLACED, TENANT_PLACED),   # re-place incarnation
+        (TENANT_PLACED, TENANT_DONE), (TENANT_PLACED, TENANT_FAILED),
+        (TENANT_PLACED, TENANT_CANCELLED),
+        (TENANT_DONE, TENANT_COLLECTED),
+    ),
+    initial=(TENANT_ACCEPTED,),
+)
+
+# the member-host liveness machine (heartbeat files under --fleet-dir,
+# refreshed like lease keepers).  "registered" is the beacon's first
+# atomic write; "silent" is a missed refresh inside TTL grace;
+# silent->dead fires past TTL (the gateway breaks the host's job
+# leases and re-places on survivors); dead->alive is a restarted host
+# re-registering under the same name.
+HOST_REGISTERED = "registered"
+HOST_ALIVE = "alive"
+HOST_SILENT = "silent"
+HOST_DEAD = "dead"
+
+PLACEMENT_MACHINE = StateMachine(
+    "placement",
+    states=(HOST_REGISTERED, HOST_ALIVE, HOST_SILENT, HOST_DEAD),
+    edges=(
+        (HOST_REGISTERED, HOST_ALIVE),
+        (HOST_ALIVE, HOST_SILENT),
+        (HOST_SILENT, HOST_ALIVE), (HOST_SILENT, HOST_DEAD),
+        (HOST_DEAD, HOST_ALIVE),
+    ),
+    initial=(HOST_REGISTERED,),
+)
+
 MACHINES: Tuple[StateMachine, ...] = (JOB_MACHINE, SHARD_MACHINE,
-                                      LEASE_MACHINE)
+                                      LEASE_MACHINE, TENANT_MACHINE,
+                                      PLACEMENT_MACHINE)
 
 
 def selfcheck() -> list:

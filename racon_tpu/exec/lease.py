@@ -61,15 +61,20 @@ def lease_ttl_s() -> float:
     return max(0.05, flags.get_float("RACON_TPU_EXEC_LEASE_TTL_S"))
 
 
-def lease_path(work_dir: str, shard_id: int) -> str:
-    return os.path.join(work_dir, f"{LEASE_PREFIX}{shard_id:04d}.json")
+def lease_path(work_dir: str, shard_id) -> str:
+    """Lease file for one work item.  Integer ids are the exec shard
+    ordinals (zero-padded for stable ls ordering); string ids are the
+    fleet's host-scoped job leases (``job_<id>``) — same claim/expiry
+    protocol either way."""
+    tag = f"{shard_id:04d}" if isinstance(shard_id, int) else str(shard_id)
+    return os.path.join(work_dir, f"{LEASE_PREFIX}{tag}.json")
 
 
 class Lease:
     """An owned shard lease; refresh with :meth:`heartbeat` (or start a
     :class:`LeaseKeeper`), drop with :meth:`release`."""
 
-    def __init__(self, work_dir: str, shard_id: int, worker: str,
+    def __init__(self, work_dir: str, shard_id, worker: str,
                  claimed_unix: float = 0.0):
         self.work_dir = work_dir
         self.shard_id = shard_id
@@ -96,7 +101,7 @@ class Lease:
             return False
 
     def start_keeper(self) -> "Lease":
-        self._keeper = LeaseKeeper(self).start()
+        self._keeper = LeaseKeeper(self).start()  # graftlint: disable=lock-discipline (one owner)
         return self
 
     def release(self) -> None:
@@ -149,7 +154,7 @@ class LeaseKeeper:
                 return
 
 
-def read_lease(work_dir: str, shard_id: int) -> Optional[dict]:
+def read_lease(work_dir: str, shard_id) -> Optional[dict]:
     """The lease payload (or None when absent/torn) — observability
     only; claims never trust the payload, only O_EXCL and mtime."""
     try:
@@ -173,11 +178,15 @@ def _pid_alive(pid) -> bool:
         return True
 
 
-def try_claim(work_dir: str, shard_id: int, worker: str,
-              ttl_s: Optional[float] = None) -> Optional[Lease]:
+def try_claim(work_dir: str, shard_id, worker: str,
+              ttl_s: Optional[float] = None,
+              keeper: bool = True) -> Optional[Lease]:
     """Attempt to claim a shard. Returns an owned :class:`Lease` (with
-    the heartbeat keeper already running), or None when another worker
-    holds a live lease. A lease past its TTL is broken (rename to a
+    the heartbeat keeper already running — unless ``keeper=False``:
+    the fleet gateway heartbeats its job leases MANUALLY, gated on the
+    owning host's liveness, so a dead host's leases age out and get
+    broken), or None when another worker holds a live lease. A lease
+    past its TTL is broken (rename to a
     tombstone — atomic, one winner) and reclaimed; a lease whose owner
     ran on *this* host and whose pid is gone is broken immediately —
     kill-then-resume must not idle out a whole TTL when the kernel
@@ -224,5 +233,6 @@ def try_claim(work_dir: str, shard_id: int, worker: str,
     finally:
         os.close(fd)
     metrics.inc("lease.claimed")
-    return Lease(work_dir, shard_id, worker,
-                 claimed_unix=claimed_unix).start_keeper()
+    lease = Lease(work_dir, shard_id, worker,
+                  claimed_unix=claimed_unix)
+    return lease.start_keeper() if keeper else lease

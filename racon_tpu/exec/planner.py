@@ -40,11 +40,13 @@ ALL local chips via the existing ``sharded_align`` /
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import List
 
 import numpy as np
 
+from ..obs import metrics
 from ..utils.logger import warn
 from .index import RunIndex
 
@@ -93,6 +95,58 @@ def estimate_job_cost(sequences: str, overlaps: str,
     if parsers.is_auto_overlaps(overlaps):
         return base + input_cost_bytes(sequences)
     return base + 2 * input_cost_bytes(overlaps)
+
+
+# admission cost-estimate cache (the fleet gateway re-estimates the
+# same spec on every placement retry, and N tenants often resubmit the
+# same input set): keyed by the CONTENT fingerprint of the spec's
+# input files — absolute path, size, mtime_ns — so an in-place rewrite
+# invalidates naturally.  Bounded; a full cache drops wholesale (the
+# entries are cheap to recompute, eviction bookkeeping is not worth
+# carrying).
+_COST_CACHE: dict = {}
+_COST_CACHE_LOCK = threading.Lock()
+_COST_CACHE_MAX = 1024
+
+
+def _spec_fingerprint(sequences: str, overlaps: str,
+                      target_sequences: str) -> tuple:
+    """Content fingerprint of one job spec's inputs (stat data only —
+    never file bytes; the estimator itself reads nothing either).
+    Raises the same ``OSError`` a vanished input would raise from
+    :func:`estimate_job_cost`."""
+    import os
+
+    from ..io import parsers
+    auto = parsers.is_auto_overlaps(overlaps)
+    paths = [sequences, target_sequences] + ([] if auto else [overlaps])
+    key = ["auto" if auto else "paf"]
+    for p in paths:
+        st = os.stat(p)
+        key.append((os.path.abspath(p), st.st_size, st.st_mtime_ns))
+    return tuple(key)
+
+
+def cached_job_cost(sequences: str, overlaps: str,
+                    target_sequences: str) -> int:
+    """:func:`estimate_job_cost` behind the fingerprint cache —
+    admission control (serve and the fleet gateway) calls THIS, so
+    repeated submissions and placement retries of one spec stop
+    re-statting/gz-sniffing the same files; hits/misses are counted
+    (``fleet.cost_cache_hits``/``fleet.cost_cache_misses``)."""
+    key = _spec_fingerprint(sequences, overlaps, target_sequences)
+    with _COST_CACHE_LOCK:
+        hit = _COST_CACHE.get(key)
+    if hit is not None:
+        metrics.inc("fleet.cost_cache_hits")
+        return hit
+    cost = estimate_job_cost(sequences, overlaps, target_sequences)
+    metrics.inc("fleet.cost_cache_misses")
+    with _COST_CACHE_LOCK:
+        if len(_COST_CACHE) >= _COST_CACHE_MAX:
+            _COST_CACHE.clear()
+        _COST_CACHE[key] = cost
+    return cost
 
 
 def parse_ram(text: str) -> int:

@@ -236,6 +236,36 @@ REGISTRY: Dict[str, Flag] = _declare([
          "Base of the client reconnect exponential backoff (doubled "
          "per attempt, deterministic CRC32 jitter added — the shared "
          "faults.backoff_s formula the exec ladder uses)."),
+    # ------------------------------------------------------- fleet serving
+    Flag("RACON_TPU_FLEET_TENANTS", "", "str",
+         "Fleet tenant configuration for the gateway (racon --gateway): "
+         "comma-separated 'name:weight:budget' entries — weight is the "
+         "stride-scheduling share (higher drains faster), budget bounds "
+         "the tenant's summed in-flight cost estimate (plain number = "
+         "MB; K/M/G/T suffixes; 0 or empty = unbounded).  Unknown "
+         "tenants get weight 1 and no budget; empty = every tenant "
+         "equal."),
+    Flag("RACON_TPU_FLEET_HOST_TTL_S", "10", "float",
+         "Member-host heartbeat time-to-live in seconds: a serve host "
+         "whose registry heartbeat file (under --fleet-dir) goes "
+         "unrefreshed for longer than this is declared dead, its job "
+         "leases are broken and its queued/running jobs are re-placed "
+         "on surviving hosts."),
+    Flag("RACON_TPU_FLEET_POLL_S", "0.2", "float",
+         "Gateway placement-loop poll interval in seconds: how often "
+         "the fleet scheduler re-scans tenant queues, host heartbeats "
+         "and in-flight job status between placement events."),
+    Flag("RACON_TPU_BENCH_FLEET", "2", "float",
+         "bench.py fleet-serving workload size in Mbp: mixed-tenant "
+         "open-loop load over a 3-host fleet (3 serve subprocesses) "
+         "behind one gateway — per-tenant fleet_p50_s/fleet_p95_s, the "
+         "isolation ratio vs an idle-fleet baseline, and migration-to-"
+         "first-result after a member SIGKILL, every result "
+         "byte-identical to its one-shot CLI run (0 disables)."),
+    Flag("RACON_TPU_BENCH_FLEET_JOBS", "12", "int",
+         "How many open-loop job submissions per tenant the fleet "
+         "bench drives through the gateway (the isolation metric's "
+         "sample size)."),
     # ------------------------------------------------ first-party overlapper
     Flag("RACON_TPU_OVERLAP", "", "str",
          "Overlap source override: 'auto' runs the first-party "

@@ -1,0 +1,26 @@
+"""racon_tpu.fleet — multi-tenant serving across a fleet of hosts.
+
+Three pieces over the round-16 resident service:
+
+- **gateway** (:mod:`.gateway`) — the TCP front door (``racon
+  --gateway HOST:PORT --fleet-dir DIR``).  Speaks the serve protocol
+  verbatim, journals every accepted job durably BEFORE acknowledging
+  (the same append/spool/CRC machinery as ``serve/journal.py``), and
+  places jobs across member hosts under per-job leases.
+- **tenants** (:mod:`.tenants`) — weighted-fair (stride) scheduling
+  over per-tenant FIFO queues, with per-tenant cost budgets
+  (``RACON_TPU_FLEET_TENANTS=name:weight:budget,...``) extending the
+  round-14 reject-with-reason admission to the fleet tier.
+- **registry** (:mod:`.registry`) — host membership as heartbeat
+  beacon files under ``--fleet-dir/hosts/``: each ``racon --serve
+  --fleet-dir`` host refreshes its beacon's mtime like a lease keeper;
+  a beacon stale past ``RACON_TPU_FLEET_HOST_TTL_S`` marks the host
+  dead and the gateway breaks its job leases and re-places the work
+  on survivors.
+"""
+
+from __future__ import annotations
+
+from .gateway import Gateway  # noqa: F401
+from .registry import HostBeacon, read_hosts  # noqa: F401
+from .tenants import TenantScheduler, parse_tenants  # noqa: F401

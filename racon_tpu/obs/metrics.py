@@ -376,6 +376,28 @@ def recovery_summary() -> Dict[str, Number]:
     }
 
 
+def fleet_summary() -> Dict[str, Number]:
+    """The fleet gateway counters the run report's ``fleet`` section
+    (schema v11) embeds: admission outcomes at the TCP front door,
+    placement/migration/preemption volume, the host-registry liveness
+    gauges and the admission cost-estimate cache accounting.  These
+    are GATEWAY-level facts published unscoped (``fleet.`` /
+    ``gateway.`` are not run prefixes), so a report built inside a
+    gateway process shows fleet-lifetime totals — all zeros for plain
+    CLI/exec/serve runs."""
+    return {
+        "jobs_accepted": counter("gateway.accepted"),
+        "jobs_rejected": counter("gateway.rejected"),
+        "jobs_placed": counter("fleet.placed"),
+        "jobs_migrated": counter("fleet.migrated"),
+        "jobs_preempted": counter("fleet.preempted"),
+        "hosts_alive": gauge("fleet.hosts_alive"),
+        "hosts_dead": counter("fleet.hosts_dead"),
+        "cost_cache_hits": counter("fleet.cost_cache_hits"),
+        "cost_cache_misses": counter("fleet.cost_cache_misses"),
+    }
+
+
 def peak_rss_bytes() -> int:
     """Lifetime peak RSS of this process (ru_maxrss is KiB on Linux,
     bytes on macOS)."""

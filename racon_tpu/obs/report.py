@@ -84,6 +84,13 @@ from .. import contracts
 # timers) and its counted bail-outs ("join_bailouts" — the host-oracle
 # ladder, never silent), and the target seed-table cache accounting
 # ("cache_hits"/"cache_misses", RACON_TPU_OVERLAP_CACHE).
+# v11 (round 23): the "fleet" section became required — fleet-serving
+# counters from the multi-tenant gateway (``gateway.*``/``fleet.*``
+# metrics): admission outcomes at the TCP front door, jobs placed on
+# member hosts, migrations after a host death and priority
+# preemptions, the host-registry liveness gauges and the admission
+# cost-estimate cache accounting.  Gateway-level, unscoped; all zeros
+# for plain CLI/exec/serve runs.
 # the schema's key sets (per section, per version) live in
 # racon_tpu/contracts.py — ONE registry shared with the schema-coherence
 # lint rule, so a schema bump is a contracts.py edit the gate enforces
@@ -115,6 +122,7 @@ _TOP = {
     "compiles": (dict, True),           # XLA compile attribution (v7)
     "dataflow": (dict, True),           # resident-dataflow bytes (v8)
     "overlap": (dict, True),            # first-party overlapper (v9/v10)
+    "fleet": (dict, True),              # fleet gateway counters (v11)
     "devices": (dict, True),            # per-chip rows ({} single-chip)
     "peak_rss_bytes": (int, True),
     "metrics": (dict, True),            # full registry snapshot
@@ -134,6 +142,7 @@ _RECOVERY_KEYS = tuple(sorted(_SCHEMA_KEYS["recovery"]))
 _COMPILES_NUM_KEYS = tuple(sorted(
     _SCHEMA_KEYS["compiles"] - {"by_function", "events"}))
 _DATAFLOW_KEYS = tuple(sorted(_SCHEMA_KEYS["dataflow"]))
+_FLEET_KEYS = tuple(sorted(_SCHEMA_KEYS["fleet"]))
 # "mode" is the one string key of the overlap section
 _OVERLAP_NUM_KEYS = tuple(sorted(_SCHEMA_KEYS["overlap"] - {"mode"}))
 _OVERLAP_MODES = contracts.OVERLAP_MODES
@@ -239,6 +248,12 @@ def build_report(kind: str, *, argv: Optional[list] = None,
         # and target-table cache hits — mode "paf" with zeros for
         # precomputed-overlap runs
         "overlap": metrics.overlap_summary(scope),
+        # fleet serving (round 23, schema v11): gateway admission,
+        # placement/migration/preemption volume, host-registry
+        # liveness and the admission cost-cache accounting —
+        # gateway-level, so every kind embeds the hosting process's
+        # totals (zeros outside a gateway process)
+        "fleet": metrics.fleet_summary(),
         # per-chip attribution (round 13): one row per local device the
         # chip scheduler drove — shards/Mbp counters, polish seconds and
         # the span-timer mirrors (dispatch/fetch per chip). {} on
@@ -316,6 +331,10 @@ def validate_report(rep) -> List[str]:
         if not isinstance(rep["dataflow"].get(key), _NUM) \
                 or isinstance(rep["dataflow"].get(key), bool):
             errors.append(f"dataflow[{key!r}] missing or non-numeric")
+    for key in _FLEET_KEYS:
+        if not isinstance(rep["fleet"].get(key), _NUM) \
+                or isinstance(rep["fleet"].get(key), bool):
+            errors.append(f"fleet[{key!r}] missing or non-numeric")
     if rep["overlap"].get("mode") not in _OVERLAP_MODES:
         errors.append(f"overlap['mode'] {rep['overlap'].get('mode')!r} "
                       f"not in {_OVERLAP_MODES}")

@@ -30,12 +30,28 @@ from .. import faults, flags
 from . import protocol
 
 
+def parse_tcp_address(address: str) -> Optional[Tuple[str, int]]:
+    """``host:port`` -> ``(host, port)`` when ``address`` names a TCP
+    endpoint (the fleet gateway's listener), else None — a unix-socket
+    path.  Disambiguation: a path contains ``/`` or exists on disk; a
+    TCP address is ``host:port`` with a numeric port (IPv6 literals
+    use the last colon)."""
+    if "/" in address or os.path.exists(address):
+        return None
+    host, sep, port = address.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        return None
+    return host, int(port)
+
+
 class ServiceClient:
-    """One connection to a :class:`PolishServer` socket, established
-    with bounded retry + backoff (a server that is restarting — socket
-    missing or refusing — is retried, not failed).  Usable as a
-    context manager; every helper returns the decoded response header
-    (and :meth:`result` the payload too)."""
+    """One connection to a :class:`PolishServer` socket — or, given a
+    ``host:port`` address, to the fleet gateway's TCP listener (same
+    protocol, same helpers) — established with bounded retry + backoff
+    (a server that is restarting — socket missing or refusing — is
+    retried, not failed).  Usable as a context manager; every helper
+    returns the decoded response header (and :meth:`result` the
+    payload too)."""
 
     def __init__(self, socket_path: str, timeout_s: float = 600.0,
                  retries: Optional[int] = None,
@@ -56,10 +72,16 @@ class ServiceClient:
         for k in range(self.retries + 1):
             try:
                 faults.check("serve.socket")
-                sock = socket.socket(socket.AF_UNIX,
-                                     socket.SOCK_STREAM)
-                sock.settimeout(self.timeout_s)
-                sock.connect(self.socket_path)
+                tcp = parse_tcp_address(self.socket_path)
+                if tcp is not None:
+                    sock = socket.create_connection(
+                        tcp, timeout=self.timeout_s)
+                    sock.settimeout(self.timeout_s)
+                else:
+                    sock = socket.socket(socket.AF_UNIX,
+                                         socket.SOCK_STREAM)
+                    sock.settimeout(self.timeout_s)
+                    sock.connect(self.socket_path)
             except (OSError, ConnectionError) as e:
                 last = e
                 if k >= self.retries:
@@ -132,6 +154,12 @@ class ServiceClient:
     def cancel(self, job_id: str) -> dict:
         return self._roundtrip({"op": "cancel", "job": job_id})
 
+    def preempt(self, job_id: str) -> dict:
+        """Ask the server to drain a job (fleet preemption): a queued
+        job is released immediately (``drained: true``); a running
+        one drains at its next ladder boundary or completes first."""
+        return self._roundtrip({"op": "preempt", "job": job_id})
+
     def shutdown(self, mode: str = "now") -> dict:
         """Stop the server; ``mode="drain"`` finishes queued +
         in-flight jobs and flushes the journal first."""
@@ -169,7 +197,7 @@ def spec_from_args(args) -> dict:
     one-shot option surface forwarded verbatim, so ``--submit`` output
     matches the equivalent one-shot invocation byte for byte."""
     from ..io import parsers
-    return {
+    spec = {
         "sequences": os.path.abspath(args.sequences),
         # the --overlaps auto sentinel travels verbatim (no file)
         "overlaps": (args.overlaps
@@ -187,6 +215,14 @@ def spec_from_args(args) -> dict:
         "threads": args.threads,
         "include_unpolished": bool(args.include_unpolished),
     }
+    # fleet routing hints ride only when given (--tenant/--priority):
+    # normalize_spec fills the defaults, and plain serve submits stay
+    # byte-for-byte what they were before the fleet round
+    if getattr(args, "tenant", None):
+        spec["tenant"] = args.tenant
+    if getattr(args, "priority", None):
+        spec["priority"] = int(args.priority)
+    return spec
 
 
 def _eprint(msg: str) -> None:

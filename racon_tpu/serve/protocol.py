@@ -21,7 +21,9 @@ Requests (``op`` selects):
   returns the EXISTING job (``"existing": true``) instead of
   duplicating compute — the hook the retrying client uses to survive
   a server restart.  Response carries the job id, or ``ok: false``
-  with the admission-rejection reason.
+  with the admission-rejection reason.  The spec's optional ``tenant``
+  and ``priority`` fields are the fleet gateway's routing hints
+  (round 23); a plain serve host records them but schedules FIFO.
 - ``status`` — one job's state (queued/running/done/failed/cancelled),
   queue position, cost estimate, ladder attempts so far.
 - ``result`` — blocks (bounded by ``timeout_s``) until the job is
@@ -32,8 +34,9 @@ Requests (``op`` selects):
 - ``cancel`` — cancels a QUEUED job; a running job cannot be safely
   interrupted mid-dispatch and the response says so.
 - ``stats`` — server-level counters (jobs done/failed, in-flight
-  footprint, queue depth, slot quarantine/restart and journal
-  recovery counters).
+  footprint, queue depth, per-tenant queue depths, a slot-health
+  summary (healthy/quarantined counts), slot quarantine/restart and
+  journal recovery counters).
 - ``shutdown`` — ``{"mode": "now"}`` (default) stops accepting and
   lets running jobs finish; ``{"mode": "drain"}`` additionally waits
   for the QUEUE to empty (bounded by ``RACON_TPU_SERVE_DRAIN_S``) and
@@ -41,7 +44,10 @@ Requests (``op`` selects):
   ``SIGTERM`` triggers.
 
 Paths in a job spec are server-local: the socket is unix-domain, so
-client and server share a filesystem by construction.
+client and server share a filesystem by construction.  The fleet
+gateway (``racon --gateway``) speaks this same protocol verbatim over
+a TCP listener — there the spec paths must name files on the fleet's
+shared filesystem (the gateway and every member host stat them).
 """
 
 from __future__ import annotations
@@ -63,6 +69,11 @@ SPEC_DEFAULTS = {
     "banded": False,
     "threads": 1,
     "include_unpolished": False,
+    # fleet routing hints (round 23): which tenant queue the gateway
+    # files the job under, and its preemption priority (higher wins;
+    # a plain serve host records them but schedules FIFO as before)
+    "tenant": "default",
+    "priority": 0,
 }
 SPEC_PATHS = ("sequences", "overlaps", "target_sequences")
 SPEC_KEYS = SPEC_PATHS + tuple(SPEC_DEFAULTS)
@@ -133,9 +144,14 @@ def normalize_spec(raw: dict) -> Tuple[Optional[dict], Optional[str]]:
             if not isinstance(val, (int, float)) or isinstance(val, bool):
                 return None, f"job spec {key!r} must be a number"
             val = float(val)
+        else:
+            if not isinstance(val, str):
+                return None, f"job spec {key!r} must be a string"
         spec[key] = val
     if spec["window_length"] <= 0:
         return None, "job spec window_length must be positive"
     if spec["threads"] < 1:
         return None, "job spec threads must be >= 1"
+    if not spec["tenant"]:
+        return None, "job spec tenant must be a non-empty string"
     return spec, None

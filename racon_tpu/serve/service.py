@@ -76,7 +76,7 @@ from .. import contracts, faults, flags, sanitize
 from ..core.polisher import PolisherType, create_polisher
 from ..exec import heartbeat as hb
 from ..exec import lease as lease_mod
-from ..exec.planner import estimate_job_cost, input_cost_bytes, parse_ram
+from ..exec.planner import cached_job_cost, input_cost_bytes, parse_ram
 from ..exec.runner import _ChipWorker
 from ..io import parsers
 from ..obs import compilewatch, metrics, report as obs_report
@@ -167,6 +167,15 @@ class Job:
         self.phases: Dict[str, float] = {}
         self.report: Optional[dict] = None
         self.worker: Optional[str] = None
+        # fleet routing hints (round 23): recorded for stats/status —
+        # a plain serve host still schedules FIFO; the gateway is the
+        # layer that turns these into weighted-fair + preemption
+        self.tenant = str(spec.get("tenant", "default"))
+        self.priority = int(spec.get("priority", 0))
+        # cooperative preemption: set by the `preempt` op on a RUNNING
+        # job; honored at the next ladder-attempt boundary (a polish
+        # dispatch is never interrupted mid-flight)
+        self.preempt = threading.Event()
         self.submitted_unix = time.time()
         self.started_at: Optional[float] = None
         self.wall_s = 0.0
@@ -204,6 +213,7 @@ class Job:
         """The protocol's status view of this job."""
         out = {"job": self.id, "state": self.state,
                "cost_bytes": self.cost,
+               "tenant": self.tenant, "priority": self.priority,
                "submitted_unix": round(self.submitted_unix, 3)}
         if self.worker:
             out["worker"] = self.worker
@@ -244,7 +254,8 @@ class PolishServer:
                  chips: int = 0, workers: int = 0,
                  budget_bytes: int = 0, max_queue: int = 0,
                  autostart: bool = True,
-                 serve_dir: Optional[str] = None):
+                 serve_dir: Optional[str] = None,
+                 fleet_dir: Optional[str] = None):
         self.socket_path = os.path.abspath(socket_path)
         self.match, self.mismatch, self.gap = match, mismatch, gap
         self.banded = banded
@@ -303,6 +314,13 @@ class PolishServer:
         self._slot_deaths: Dict[int, int] = {}
         self._quarantined: set = set()
         self._supervisor: Optional[threading.Thread] = None
+        # fleet membership (round 23): a --fleet-dir host advertises
+        # itself to the gateway with a heartbeat beacon file; a beacon
+        # gone stale past RACON_TPU_FLEET_HOST_TTL_S is how the
+        # gateway declares this host dead and migrates its jobs
+        self.fleet_dir = os.path.abspath(fleet_dir) if fleet_dir \
+            else None
+        self._beacon = None
 
     # ------------------------------------------------------- engine pool
 
@@ -486,8 +504,10 @@ class PolishServer:
                 f"{profile}, the job asked for {requested} — submit to "
                 f"a server started with those scores, or restart this "
                 f"one with them"), False
-        cost = estimate_job_cost(spec["sequences"], spec["overlaps"],
-                                 spec["target_sequences"])
+        # content-fingerprint cached (round 23): a fleet gateway and a
+        # member host pricing the same inputs stat them once, not twice
+        cost = cached_job_cost(spec["sequences"], spec["overlaps"],
+                               spec["target_sequences"])
         if cost > self.budget_bytes:
             return None, (
                 f"job footprint estimate {cost >> 20} MB exceeds the "
@@ -682,6 +702,16 @@ class PolishServer:
         blob: Optional[bytes] = None
         try:
             for attempt_no in range(64):  # ladder is finite
+                if job.preempt.is_set():
+                    # cooperative preemption (round 23): honored only
+                    # at ladder-attempt boundaries — a polish dispatch
+                    # is never interrupted, so a first attempt that
+                    # succeeds outruns its own preemption (completion
+                    # wins; drain, never kill)
+                    job.attempts.append({
+                        "n": attempt_no, "engine": "-",
+                        "class": "preempt", "action": "drain"})
+                    break
                 try:
                     faults.check("serve.polish", attempt=attempt_no)
                     blob = self._polish(job, worker, cpu=tier_cpu)
@@ -781,6 +811,14 @@ class PolishServer:
                 # RACON_TPU_SANITIZE=1)
                 compilewatch.seal(f"serve warm path "
                                   f"(job {job.id} complete)")
+            elif job.preempt.is_set():
+                # drained at a ladder boundary: terminal here, but NOT
+                # a failure — the fleet gateway requeues the job and
+                # places it again under a fresh incarnation key
+                job.state = CANCELLED
+                job.error = job.error or (
+                    "preempted: drained back to the queue at a "
+                    "ladder boundary")
             else:
                 job.state = FAILED
             # the per-job run report: built from THIS job's metric
@@ -810,7 +848,8 @@ class PolishServer:
         here is logged, not raised: losing a ``done`` record only means
         the job re-runs (byte-identically) after a restart — safe,
         where a dead worker thread is not."""
-        if self._journal is None or job.state not in (DONE, FAILED):
+        if self._journal is None or \
+                job.state not in (DONE, FAILED, CANCELLED):
             return
         try:
             if job.state == DONE:
@@ -820,6 +859,13 @@ class PolishServer:
                     "spool": job.spool,
                     "wall_s": round(job.wall_s, 3),
                     "engine": job.engine})
+            elif job.state == CANCELLED:
+                # a preempt-drained run: without this record a restart
+                # would re-run a job the gateway already re-placed
+                # elsewhere — a duplicate polish nobody collects
+                self._journal.append({"rec": "cancelled",
+                                      "job": job.id,
+                                      "error": job.error or ""})
             else:
                 self._journal.append({"rec": "failed", "job": job.id,
                                       "error": job.error or ""})
@@ -1192,7 +1238,7 @@ class PolishServer:
                                      "cost_bytes": job.cost,
                                      "existing": existing})
             return True
-        if op in ("status", "result", "cancel"):
+        if op in ("status", "result", "cancel", "preempt"):
             job = self._jobs.get(msg.get("job", ""))
             if job is None:
                 protocol.send_msg(conn, {
@@ -1208,18 +1254,27 @@ class PolishServer:
                 return True
             if op == "cancel":
                 return self._op_cancel(conn, job)
+            if op == "preempt":
+                return self._op_preempt(conn, job)
             return self._op_result(conn, job, msg)
         if op == "stats":
             with self._lock:
                 counts = dict(self._counts)
                 depth = len(self._queue)
                 running = self._running_cost
+                tenants: Dict[str, int] = {}
+                for queued_job in self._queue:
+                    tenants[queued_job.tenant] = \
+                        tenants.get(queued_job.tenant, 0) + 1
             out = {
                 "ok": True, **counts, "queued": depth,
+                "tenants": tenants,
                 "running_cost_bytes": running,
                 "budget_bytes": self.budget_bytes,
                 "peak_rss_bytes": metrics.peak_rss_bytes(),
                 "quarantined_slots": len(self._quarantined),
+                "slots": {"healthy": self.healthy_workers(),
+                          "quarantined": len(self._quarantined)},
                 "slot_restarts": int(metrics.counter("slot.restarts"))}
             if self._journal is not None:
                 out["serve_dir"] = self.serve_dir
@@ -1280,6 +1335,54 @@ class PolishServer:
             "error": f"job {job.id} is not queued ({job.state}) — a "
                      f"running job cannot be safely interrupted "
                      f"mid-dispatch"})
+        return True
+
+    def _op_preempt(self, conn, job: Job) -> bool:
+        """The fleet gateway's drain request (round 23): a QUEUED job
+        is released immediately (``drained: true`` — it never ran); a
+        RUNNING job gets its cooperative preempt flag and drains at
+        the next ladder-attempt boundary or completes first
+        (``drained: false`` — the gateway watches its status either
+        way).  Never kills a dispatch mid-flight."""
+        drained = False
+        running = False
+        with self._cond:
+            if job in self._queue:
+                self._queue.remove(job)
+                job.state = CANCELLED
+                job.error = "preempted by the fleet scheduler"
+                self._counts["cancelled"] += 1
+                self._retired.append(job.id)
+                job.done.set()
+                drained = True
+            elif job.state == RUNNING:
+                job.preempt.set()
+                running = True
+        # reply OUTSIDE the scheduler lock, like _op_cancel
+        if drained:
+            if self._journal is not None:
+                try:
+                    self._journal.append({"rec": "cancelled",
+                                          "job": job.id})
+                except Exception as e:
+                    log_swallowed(
+                        "serve: journal preempt record failed (the "
+                        "job would re-run after a restart)", e)
+            protocol.send_msg(conn, {"ok": True, "job": job.id,
+                                     "state": job.state,
+                                     "drained": True})
+            return True
+        if running:
+            protocol.send_msg(conn, {
+                "ok": True, "job": job.id, "state": job.state,
+                "drained": False,
+                "note": "running — drains at the next ladder "
+                        "boundary or completes first"})
+            return True
+        protocol.send_msg(conn, {
+            "ok": False, "job": job.id, "state": job.state,
+            "error": f"job {job.id} is already terminal "
+                     f"({job.state})"})
         return True
 
     def _op_result(self, conn, job: Job, msg: dict) -> bool:
@@ -1523,6 +1626,17 @@ class PolishServer:
                                  name="racon-serve-heartbeat",
                                  daemon=True)
             t.start()
+        if self.fleet_dir:
+            # registered AFTER the socket is bound: the beacon
+            # advertises a listener the gateway can actually reach
+            from ..fleet import registry as fleet_registry
+            beacon = fleet_registry.HostBeacon(
+                self.fleet_dir, socket_path=self.socket_path).start()
+            # written once before the accept loop starts; shutdown
+            # reads it only after _stop is set
+            self._beacon = beacon  # graftlint: disable=lock-discipline (pre-accept-loop write)
+            _eprint(f"fleet member {self._beacon.name} registered "
+                    f"in {self.fleet_dir}")
         _eprint(f"listening on {self.socket_path} "
                 f"(server {self.worker})")
         self.started.set()
@@ -1602,6 +1716,11 @@ class PolishServer:
         if self._stop.is_set():
             return
         self._stop.set()
+        if self._beacon is not None:
+            # deregister (clean goodbye): the gateway sees the beacon
+            # withdrawn instead of waiting a TTL to declare us dead
+            self._beacon.stop()
+            self._beacon = None  # graftlint: disable=lock-discipline (_stop-gated shutdown)
         with self._cond:
             for job in self._queue:
                 job.state = FAILED

@@ -103,7 +103,7 @@ def test_contract_audit_diffs_registry_against_seen(monkeypatch, capsys):
     assert "contract audit" in capsys.readouterr().err
 
 
-# ------------------------------------- the validator round-trip (v10)
+# ------------------------------------- the validator round-trip (v11)
 
 # report keys whose backing metric a small-but-real polish (first-party
 # overlapper, device aligner + consensus, span timers armed) MUST drive.
@@ -129,7 +129,7 @@ _EXERCISED_KEYS = frozenset((
 
 
 def test_report_roundtrip_all_kinds_zero_defaulted_keys(tmp_path):
-    """Satellite: round-trip the v10 validator over all three report
+    """Satellite: round-trip the v11 validator over all three report
     kinds built from ONE real synthetic polish.  Every kind validates
     clean, and the exit audit finds no validator-defaulted key among
     the sections the run exercised — i.e. the REPORT_BACKING map is

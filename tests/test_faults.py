@@ -218,6 +218,77 @@ def test_lease_race_single_winner(tmp_path):
     wins[0].release()
 
 
+def test_lease_cross_process_expiry_single_winner(tmp_path):
+    """The fleet placement contract at process scale: a lease claimed
+    by a subprocess that is SIGKILLed (no release, no more
+    heartbeats) is broken by a later claimant — and when TWO separate
+    processes race to reclaim it, the tombstone rename admits exactly
+    one winner."""
+    import os
+    import signal
+
+    wd = str(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    claimer = (
+        "import sys, time\n"
+        "from racon_tpu.exec import lease\n"
+        "l = lease.try_claim(sys.argv[1], 7, 'victim', ttl_s=1.0)\n"
+        "assert l is not None\n"
+        "print('CLAIMED', flush=True)\n"
+        "time.sleep(600)\n")
+    victim = subprocess.Popen(
+        [sys.executable, "-c", claimer, wd], env=env,
+        stdout=subprocess.PIPE, cwd=str(pathlib.Path(__file__).parents[1]))
+    try:
+        line = victim.stdout.readline()
+        assert b"CLAIMED" in line, line
+        assert lease.read_lease(wd, 7)["worker"] == "victim"
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=30)
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+            victim.wait()
+    # in-process claim still loses while the mtime is fresh: the TTL
+    # (or the dead-pid fast path) is what admits the reclaim, not the
+    # mere absence of the owner process
+    reclaimer = (
+        "import sys, time\n"
+        "from racon_tpu.exec import lease\n"
+        "deadline = time.monotonic() + 60\n"
+        "while time.monotonic() < deadline:\n"
+        "    l = lease.try_claim(sys.argv[1], 7, sys.argv[2],\n"
+        "                        ttl_s=1.0)\n"
+        "    if l is not None:\n"
+        "        print('WON', flush=True)\n"
+        "        time.sleep(600)\n"
+        "    info = lease.read_lease(sys.argv[1], 7)\n"
+        "    if info and str(info.get('worker', ''))."
+        "startswith('reclaimer-'):\n"
+        "        print('LOST', flush=True)\n"
+        "        sys.exit(0)\n"
+        "    time.sleep(0.05)\n"
+        "print('TIMEOUT', flush=True)\n")
+    racers = [subprocess.Popen(
+        [sys.executable, "-c", reclaimer, wd, f"reclaimer-{k}"],
+        env=env, stdout=subprocess.PIPE,
+        cwd=str(pathlib.Path(__file__).parents[1])) for k in range(2)]
+    try:
+        verdicts = [p.stdout.readline() for p in racers]
+        assert sum(b"WON" in v for v in verdicts) == 1, verdicts
+        assert sum(b"LOST" in v for v in verdicts) == 1, verdicts
+        winner = next(p for p, v in zip(racers, verdicts)
+                      if b"WON" in v)
+        info = lease.read_lease(wd, 7)
+        assert info["worker"].startswith("reclaimer-")
+        assert info["pid"] == winner.pid
+    finally:
+        for p in racers:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
 # --------------------------------------------------------- ladder: classes
 
 def test_transient_fault_backoff_retries_same_engine(assembly, tmp_path,

@@ -1,0 +1,701 @@
+"""Fleet serving (round 20): the multi-tenant gateway, weighted-fair
+tenant scheduling, and lease-backed placement across serve hosts.
+
+Acceptance contract at test scale: jobs submitted through the gateway
+come back **byte-identical** to the equivalent one-shot CLI run;
+tenants drain in weight proportion and per-tenant budgets reject with
+a reason; a gateway restart recovers journaled jobs (done-but-
+uncollected results serve from the fleet spool with ZERO hosts — no
+re-polish by construction); a SIGKILLed member's leased jobs are
+broken and re-placed on survivors with zero lost and zero duplicated
+results; and a high-priority job preempts a running lower-priority
+one by DRAINING it back to the queue at a ladder boundary, never
+killing it mid-window.  The ``gateway.accept`` and ``fleet.place``
+fault sites are exercised with the real injection grammar.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from racon_tpu.exec.planner import cached_job_cost
+from racon_tpu.fleet.gateway import Gateway, parse_gateway_address
+from racon_tpu.fleet.registry import HostBeacon, host_ttl_s, read_hosts
+from racon_tpu.fleet.tenants import TenantScheduler, parse_tenants
+from racon_tpu.obs import metrics
+from racon_tpu.serve.client import ServiceClient, parse_tcp_address
+from racon_tpu.serve.service import PolishServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -------------------------------------------------------------- workloads
+
+def _assembly(td, sizes, seed=31, prefix="a"):
+    """Synthetic per-contig assembly triple (the test_serve generator,
+    re-homed so the fleet tests stand alone)."""
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    comp = bytes.maketrans(b"ACGT", b"TGCA")
+
+    def mutate(seq, rate):
+        out = seq.copy()
+        flips = rng.random(len(out)) < rate
+        out[flips] = bases[rng.integers(0, 4, int(flips.sum()))]
+        return out
+
+    truths = [bases[rng.integers(0, 4, n)] for n in sizes]
+    layout = os.path.join(td, f"{prefix}_layout.fasta")
+    with open(layout, "wb") as f:
+        for ti, t in enumerate(truths):
+            f.write(b">ctg%d\n" % ti + mutate(t, 0.06).tobytes() + b"\n")
+    reads = os.path.join(td, f"{prefix}_reads.fastq")
+    paf = os.path.join(td, f"{prefix}_ovl.paf")
+    with open(reads, "wb") as rf, open(paf, "wb") as pf:
+        ri = 0
+        for ti, truth in enumerate(truths):
+            contig = len(truth)
+            for start in range(0, max(1, contig - 600), 150):
+                end = min(start + 900, contig)
+                read = mutate(truth[start:end], 0.08)
+                name = b"%s_read%d" % (prefix.encode(), ri)
+                strand = b"-" if ri % 3 == 0 else b"+"
+                rb = (read.tobytes().translate(comp)[::-1]
+                      if strand == b"-" else read.tobytes())
+                rf.write(b"@" + name + b"\n" + rb + b"\n+\n"
+                         + b"9" * len(read) + b"\n")
+                pf.write(b"\t".join([
+                    name, b"%d" % len(read), b"0", b"%d" % len(read),
+                    strand, b"ctg%d" % ti, b"%d" % contig,
+                    b"%d" % start, b"%d" % end, b"%d" % (len(read) // 2),
+                    b"%d" % len(read), b"255"]) + b"\n")
+                ri += 1
+    return reads, paf, layout
+
+
+def _spec(reads, paf, layout, **opts):
+    spec = {"sequences": reads, "overlaps": paf,
+            "target_sequences": layout, "window_length": 150,
+            "threads": 2}
+    spec.update(opts)
+    return spec
+
+
+def _oneshot_cli(reads, paf, layout, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "racon_tpu", "-w", "150", "-t", "2",
+         *extra, reads, paf, layout],
+        capture_output=True, timeout=600, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    return proc.stdout
+
+
+@pytest.fixture()
+def short_tmp():
+    """AF_UNIX socket paths are length-bounded (~107 bytes); sockets
+    live in a short /tmp dir."""
+    with tempfile.TemporaryDirectory(dir="/tmp", prefix="rfl") as td:
+        yield td
+
+
+@pytest.fixture()
+def fast_fleet(monkeypatch):
+    """Test-scale fleet timing: tight heartbeat TTL and placement
+    poll so membership transitions happen in test time, no warm-shape
+    startup compiles."""
+    monkeypatch.setenv("RACON_TPU_SERVE_WARM_SHAPES", "")
+    monkeypatch.setenv("RACON_TPU_FLEET_HOST_TTL_S", "1.0")
+    monkeypatch.setenv("RACON_TPU_FLEET_POLL_S", "0.05")
+    yield monkeypatch
+
+
+class _Host:
+    """In-process fleet member: a PolishServer with a --fleet-dir
+    beacon, serve_forever on a thread."""
+
+    def __init__(self, td, name, fleet_dir, **kw):
+        self.server = PolishServer(os.path.join(td, f"{name}.sock"),
+                                   fleet_dir=fleet_dir, **kw)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.server.started.wait(60), "host did not start"
+        return self.server
+
+    def __exit__(self, exc_type, exc, tb):
+        self.server.shutdown()
+        self.thread.join(timeout=30)
+        return False
+
+
+class _Gate:
+    """In-process gateway harness on an ephemeral TCP port."""
+
+    def __init__(self, fleet_dir, **kw):
+        self.gateway = Gateway("127.0.0.1:0", fleet_dir, **kw)
+        self.thread = threading.Thread(
+            target=self.gateway.serve_forever, daemon=True)
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.gateway.started.wait(60), "gateway did not start"
+        self.address = f"127.0.0.1:{self.gateway.port}"
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.gateway.shutdown("now")
+        self.thread.join(timeout=30)
+        return False
+
+    def client(self, timeout_s=300.0):
+        return ServiceClient(self.address, timeout_s=timeout_s)
+
+    def wait_hosts(self, n, timeout_s=30.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self.client(timeout_s=10.0) as c:
+                if c.ping().get("hosts", {}).get("alive", 0) >= n:
+                    return
+            time.sleep(0.05)
+        raise AssertionError(f"{n} hosts never registered")
+
+
+def _journal_records(fleet_dir):
+    path = os.path.join(fleet_dir, "journal.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path, "rb") as f:
+        for line in f.read().splitlines():
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+# ------------------------------------------------- tenant scheduler units
+
+def test_parse_tenants_grammar():
+    cfg = parse_tenants("alpha:3,beta:1:512M, gamma:2.5:1G")
+    assert cfg["alpha"] == (3.0, 0)
+    assert cfg["beta"] == (1.0, 512 << 20)
+    assert cfg["gamma"] == (2.5, 1 << 30)
+    assert parse_tenants("") == {}
+    for bad in ("alpha", "alpha:x", "alpha:0", "alpha:-1", ":3",
+                "alpha:1:2:3"):
+        with pytest.raises(ValueError):
+            parse_tenants(bad)
+
+
+def test_stride_weighted_fairness():
+    """alpha:3 vs beta:1 drains 3:1 over any window, and an idle
+    tenant does not bank credit to later monopolize."""
+    sched = TenantScheduler(parse_tenants("alpha:3,beta:1"))
+    for i in range(12):
+        sched.push("alpha", f"a{i}")
+    for i in range(4):
+        sched.push("beta", f"b{i}")
+    first8 = [sched.pop()[0] for _ in range(8)]
+    assert first8.count("alpha") == 6 and first8.count("beta") == 2
+    # drain the rest, then let beta idle while alpha works: when beta
+    # comes back it starts at the pass floor, not at zero
+    while sched.pop() is not None:
+        pass
+    for i in range(20):
+        sched.push("alpha", f"a2{i}")
+    for _ in range(10):
+        assert sched.pop()[0] == "alpha"
+    sched.push("beta", "late")
+    order = [sched.pop()[0] for _ in range(4)]
+    # beta gets its fair turn promptly but cannot claim every slot
+    assert "beta" in order and order.count("alpha") >= 2
+
+
+def test_priority_and_requeue_ordering():
+    sched = TenantScheduler()
+    sched.push("t", "low1", priority=0)
+    sched.push("t", "hi", priority=5)
+    sched.push("t", "low2", priority=0)
+    assert sched.peek_priority() == ("t", 5, "hi")
+    assert sched.pop() == ("t", "hi")
+    assert sched.pop() == ("t", "low1")
+    # a drained/migrated job re-enters at the FRONT of its class
+    sched.push("t", "low3", priority=0)
+    sched.requeue("t", "drained", priority=0)
+    assert sched.pop() == ("t", "drained")
+    assert sched.remove("t", "low3")
+    assert not sched.remove("t", "low3")
+    assert sched.pop() == ("t", "low2")
+    assert len(sched) == 0 and sched.depths() == {}
+
+
+def test_budget_admit_check_rejects_with_reason():
+    sched = TenantScheduler(parse_tenants("cap:1:10M"))
+    assert sched.admit_check("cap", 6 << 20) is None
+    sched.charge("cap", 6 << 20)
+    reason = sched.admit_check("cap", 6 << 20)
+    assert reason is not None and "budget exhausted" in reason
+    assert "cap" in reason and "RACON_TPU_FLEET_TENANTS" in reason
+    sched.uncharge("cap", 6 << 20)
+    assert sched.admit_check("cap", 6 << 20) is None
+    # unknown tenants are unbounded (weight 1, no budget)
+    assert sched.admit_check("stranger", 1 << 40) is None
+
+
+# --------------------------------------------------------- host registry
+
+def test_host_beacon_lifecycle(short_tmp, fast_fleet):
+    """announce -> alive; stale mtime -> not alive; stop -> withdrawn
+    (the explicit goodbye the gateway sees before any TTL)."""
+    beacon = HostBeacon(short_tmp, socket_path="/tmp/h0.sock",
+                        name="h0").start()
+    try:
+        hosts = read_hosts(short_tmp)
+        assert "h0" in hosts and hosts["h0"]["alive"]
+        assert hosts["h0"]["socket"] == "/tmp/h0.sock"
+        # a beacon stale past the TTL reads as not-alive
+        stale = time.time() - 10 * host_ttl_s()
+        os.utime(beacon.path, (stale, stale))
+        assert not read_hosts(short_tmp)["h0"]["alive"]
+        # ...and the keeper heals it within an interval
+        deadline = time.monotonic() + 10
+        while not read_hosts(short_tmp).get("h0", {}).get("alive"):
+            assert time.monotonic() < deadline, \
+                "beacon keeper never refreshed the heartbeat"
+            time.sleep(0.05)
+    finally:
+        beacon.stop()
+    assert "h0" not in read_hosts(short_tmp)
+
+
+def test_gateway_address_parsing():
+    assert parse_gateway_address("127.0.0.1:9000") == \
+        ("127.0.0.1", 9000)
+    assert parse_gateway_address(":0") == ("127.0.0.1", 0)
+    for bad in ("nope", "host:port", "host:-1"):
+        with pytest.raises(ValueError):
+            parse_gateway_address(bad)
+    # the client disambiguates TCP addresses from unix socket paths
+    assert parse_tcp_address("127.0.0.1:9000") == ("127.0.0.1", 9000)
+    assert parse_tcp_address("/tmp/racon.sock") is None
+    assert parse_tcp_address("racon.sock:9000") == ("racon.sock", 9000)
+
+
+# ------------------------------------------------- cost-estimate caching
+
+def test_cost_cache_fingerprint(short_tmp):
+    """Repeat estimates of one spec hit the content-fingerprint cache;
+    rewriting an input invalidates it (satellite: fleet.cost_cache_*
+    counters)."""
+    reads, paf, layout = _assembly(short_tmp, [1200], seed=7,
+                                   prefix="cc")
+    h0 = metrics.counter("fleet.cost_cache_hits")
+    m0 = metrics.counter("fleet.cost_cache_misses")
+    cost = cached_job_cost(reads, paf, layout)
+    assert cost > 0
+    assert cached_job_cost(reads, paf, layout) == cost
+    assert metrics.counter("fleet.cost_cache_hits") == h0 + 1
+    assert metrics.counter("fleet.cost_cache_misses") == m0 + 1
+    # an in-place rewrite changes (size, mtime_ns): natural miss
+    with open(reads, "ab") as f:
+        f.write(b"")
+    os.utime(reads, (time.time() + 5, time.time() + 5))
+    assert cached_job_cost(reads, paf, layout) == cost
+    assert metrics.counter("fleet.cost_cache_misses") == m0 + 2
+
+
+# --------------------------------------------------- gateway integration
+
+def test_gateway_round_trip_byte_identity(short_tmp, fast_fleet):
+    """Jobs through the gateway come back byte-identical to the
+    one-shot CLI; idempotency keys dedupe fleet-wide; stats report
+    per-tenant depths, budgets, host membership and fleet metrics."""
+    fast_fleet.setenv("RACON_TPU_FLEET_TENANTS", "alpha:3,beta:1")
+    reads, paf, layout = _assembly(short_tmp, [2000], prefix="rt")
+    want = _oneshot_cli(reads, paf, layout)
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    with _Host(short_tmp, "h0", fleet_dir, num_threads=2), \
+            _Host(short_tmp, "h1", fleet_dir, num_threads=2), \
+            _Gate(fleet_dir) as gate:
+        gate.wait_hosts(2)
+        with gate.client() as c:
+            sub = c.submit(_spec(reads, paf, layout, tenant="alpha",
+                                 priority=1), key="rt-1")
+            assert sub["ok"] and sub["tenant"] == "alpha", sub
+            header, payload = c.result(sub["job"], timeout_s=240)
+            assert header["ok"] and header["state"] == "done", header
+            assert payload == want, \
+                "gateway result diverged from the one-shot CLI"
+            assert header["host"] in ("h0", "h1")
+            # fleet-wide idempotency: same key -> the existing job
+            dup = c.submit(_spec(reads, paf, layout, tenant="alpha"),
+                           key="rt-1")
+            assert dup["ok"] and dup["existing"]
+            assert dup["job"] == sub["job"]
+            st = c.stats()
+            assert st["ok"] and st["done"] >= 1
+            assert st["hosts"]["alive"] == 2
+            assert isinstance(st["tenants"], dict)
+            assert isinstance(st["fleet"], dict)
+        # the gateway journal holds the full lifecycle: submitted ->
+        # running -> done -> collected, exactly once each
+        recs = _journal_records(fleet_dir)
+        by_kind = {}
+        for r in recs:
+            if r.get("job") == sub["job"]:
+                by_kind[r["rec"]] = by_kind.get(r["rec"], 0) + 1
+        assert by_kind.get("submitted") == 1
+        assert by_kind.get("running") == 1
+        assert by_kind.get("done") == 1
+        assert by_kind.get("collected") == 1
+
+
+def test_serve_stats_tenants_and_slots(short_tmp, fast_fleet):
+    """The serve ``stats`` op (satellite): per-tenant queue depths and
+    the worker-slot health summary."""
+    reads, paf, layout = _assembly(short_tmp, [1500], prefix="st")
+    server = PolishServer(os.path.join(short_tmp, "racon.sock"),
+                          num_threads=2)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    assert server.started.wait(60)
+    try:
+        with ServiceClient(server.socket_path) as c:
+            # one running + two queued under distinct tenants: the
+            # 1-slot server reports both queued tenants' depths
+            first = c.submit(_spec(reads, paf, layout))
+            assert first["ok"]
+            a = c.submit(_spec(reads, paf, layout, tenant="alpha"))
+            b = c.submit(_spec(reads, paf, layout, tenant="beta"))
+            assert a["ok"] and b["ok"]
+            st = c.stats()
+            assert st["ok"]
+            assert st["slots"] == {"healthy": 1, "quarantined": 0}
+            depth = st["tenants"]
+            assert depth.get("alpha", 0) + depth.get("beta", 0) >= 1
+            for jid in (first["job"], a["job"], b["job"]):
+                header, _ = c.result(jid, timeout_s=240)
+                assert header["ok"], header
+            st = c.stats()
+            assert st["tenants"] == {}
+            assert st["slots"]["healthy"] == 1
+    finally:
+        server.shutdown()
+        thread.join(timeout=30)
+
+
+def test_gateway_budget_rejects_with_reason(short_tmp, fast_fleet):
+    """A tenant over budget is rejected with the reason (round-14
+    admission contract at the fleet tier) and nothing is journaled."""
+    fast_fleet.setenv("RACON_TPU_FLEET_TENANTS", "cap:1:1K")
+    reads, paf, layout = _assembly(short_tmp, [1500], prefix="bg")
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    with _Gate(fleet_dir) as gate:
+        with gate.client() as c:
+            resp = c.submit(_spec(reads, paf, layout, tenant="cap"))
+            assert not resp["ok"]
+            assert "budget exhausted" in resp["error"]
+            assert c.stats()["rejected"] == 1
+    assert not any(r.get("rec") == "submitted"
+                   for r in _journal_records(fleet_dir))
+
+
+def test_gateway_accept_fault_keyed_retry(short_tmp, fast_fleet):
+    """The ``gateway.accept`` fault site: an accept-path fault fires
+    BEFORE the journal write and ack, so the connection dies pre-ack
+    and the client's keyed retry lands exactly one job."""
+    fast_fleet.setenv("RACON_TPU_FAULTS", "gateway.accept:err@1")
+    reads, paf, layout = _assembly(short_tmp, [1500], prefix="ga")
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    with _Host(short_tmp, "h0", fleet_dir, num_threads=2), \
+            _Gate(fleet_dir) as gate:
+        gate.wait_hosts(1)
+        c = gate.client()
+        try:
+            spec = _spec(reads, paf, layout)
+            with pytest.raises((ConnectionError, OSError)):
+                c.submit(spec, key="ga-1")
+            c.reconnect()
+            resub = c.submit(spec, key="ga-1")
+            assert resub["ok"] and not resub["existing"], resub
+            header, payload = c.result(resub["job"], timeout_s=240)
+            assert header["ok"] and payload.startswith(b">ctg0")
+            # the faulted first attempt died BEFORE the journal
+            # write: exactly one submitted record exists (read before
+            # shutdown compacts the collected job away)
+            subs = [r for r in _journal_records(fleet_dir)
+                    if r.get("rec") == "submitted"]
+            assert len(subs) == 1 and subs[0]["key"] == "ga-1"
+        finally:
+            c.close()
+
+
+def test_fleet_place_fault_requeues_and_retries(short_tmp, fast_fleet):
+    """The ``fleet.place`` fault site: a placement attempt that dies
+    mid-flight requeues the job and the next tick places it — the
+    client never notices."""
+    fast_fleet.setenv("RACON_TPU_FAULTS", "fleet.place:io@1")
+    reads, paf, layout = _assembly(short_tmp, [1500], prefix="fp")
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    with _Host(short_tmp, "h0", fleet_dir, num_threads=2), \
+            _Gate(fleet_dir) as gate:
+        gate.wait_hosts(1)
+        with gate.client() as c:
+            sub = c.submit(_spec(reads, paf, layout))
+            assert sub["ok"]
+            header, payload = c.result(sub["job"], timeout_s=240)
+            assert header["ok"], header
+            assert payload.startswith(b">ctg0")
+            assert metrics.counter("faults.injected.fleet.place") >= 1
+
+
+def test_gateway_restart_serves_done_from_spool(short_tmp, fast_fleet):
+    """Gateway crash-restart (round-16 semantics at the fleet tier): a
+    job done-but-uncollected at shutdown is served by the restarted
+    gateway from the fleet spool — with ZERO hosts running, so the
+    absence of re-polish is structural, not statistical."""
+    reads, paf, layout = _assembly(short_tmp, [2000], prefix="rc")
+    want = _oneshot_cli(reads, paf, layout)
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    with _Host(short_tmp, "h0", fleet_dir, num_threads=2):
+        with _Gate(fleet_dir) as gate:
+            gate.wait_hosts(1)
+            with gate.client() as c:
+                sub = c.submit(_spec(reads, paf, layout), key="rc-1")
+                assert sub["ok"]
+                jid = sub["job"]
+                deadline = time.monotonic() + 240
+                while True:
+                    st = c.status(jid)
+                    if st.get("state") == "done":
+                        break
+                    assert st.get("state") not in ("failed",
+                                                   "cancelled"), st
+                    assert time.monotonic() < deadline
+                    time.sleep(0.1)
+    # every host is down; a fresh gateway on the same fleet-dir must
+    # still serve the spooled result byte-identically
+    with _Gate(fleet_dir) as gate:
+        with gate.client() as c:
+            dup = c.submit(_spec(reads, paf, layout), key="rc-1")
+            assert dup["ok"] and dup["existing"] and dup["job"] == jid
+            header, payload = c.result(jid, timeout_s=60)
+            assert header["ok"], header
+            assert payload == want, \
+                "recovered fleet result diverged from the one-shot CLI"
+
+
+def test_fleet_preemption_chaos(short_tmp, fast_fleet):
+    """Priority preemption drains, never kills: a low-priority job
+    caught in a transient-retry backoff is drained back to the queue
+    at the ladder boundary, the high-priority job takes the slot, and
+    BOTH complete byte-identically (the victim on a fresh placement
+    incarnation)."""
+    # the victim's first polish attempt fails transient-io and sits in
+    # a ~3-5s backoff — the deterministic drain window
+    fast_fleet.setenv("RACON_TPU_FAULTS", "serve.polish:io@1")
+    fast_fleet.setenv("RACON_TPU_EXEC_BACKOFF_S", "4.0")
+    reads, paf, layout = _assembly(short_tmp, [2000], prefix="pr")
+    want = _oneshot_cli(reads, paf, layout)
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    done_at = {}
+    with _Host(short_tmp, "h0", fleet_dir, num_threads=2), \
+            _Gate(fleet_dir) as gate:
+        gate.wait_hosts(1)
+        with gate.client() as c:
+            victim = c.submit(_spec(reads, paf, layout, priority=0),
+                              key="pr-victim")
+            assert victim["ok"]
+            deadline = time.monotonic() + 60
+            while c.status(victim["job"]).get("state") != "placed":
+                assert time.monotonic() < deadline, \
+                    "victim was never placed"
+                time.sleep(0.02)
+            time.sleep(0.5)  # let the host fail attempt 1 into backoff
+            urgent = c.submit(_spec(reads, paf, layout, priority=5),
+                              key="pr-urgent")
+            assert urgent["ok"]
+
+        def fetch(jid, label):
+            with gate.client() as c2:
+                header, payload = c2.result(jid, timeout_s=240)
+            assert header.get("ok"), (label, header)
+            assert payload == want, \
+                f"{label} result diverged from the one-shot CLI"
+            done_at[label] = time.monotonic()
+
+        threads = [threading.Thread(target=fetch, args=args)
+                   for args in ((urgent["job"], "urgent"),
+                                (victim["job"], "victim"))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+            assert not t.is_alive(), "a fetch never completed"
+        with gate.client() as c:
+            st = c.stats()
+            assert st["preempted"] >= 1, st
+            row = c.status(victim["job"])
+            assert row.get("state") == "collected"
+        # the victim's journal trail shows two placement incarnations
+        # under DIFFERENT host keys (a cancelled answer must never be
+        # inherited by the re-placement); read before shutdown
+        # compacts the collected jobs away
+        runs = [r for r in _journal_records(fleet_dir)
+                if r.get("rec") == "running"
+                and r["job"] == victim["job"]]
+        assert len(runs) >= 2
+        assert runs[0]["hkey"] != runs[-1]["hkey"]
+    assert done_at["urgent"] < done_at["victim"], (
+        "the high-priority job should finish before the drained "
+        "victim's re-run")
+
+
+def test_fleet_migration_chaos_kill_host(short_tmp, fast_fleet):
+    """THE fleet crash contract: SIGKILL a member with a leased job in
+    flight — the gateway breaks the dead host's lease and re-places
+    the job on a survivor, every result byte-identical, zero lost,
+    zero duplicated."""
+    reads, paf, layout = _assembly(short_tmp, [2000], prefix="mg")
+    want = _oneshot_cli(reads, paf, layout)
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    sick_sock = os.path.join(short_tmp, "sick.sock")
+    log_path = os.path.join(short_tmp, "sick.log")
+    # the doomed member is a real subprocess (so SIGKILL is a real
+    # SIGKILL) wedged by an every-attempt transient fault with a huge
+    # backoff: any job placed on it stays leased-and-running until
+    # the kill
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_SERVE_WARM_SHAPES="",
+               RACON_TPU_FLEET_HOST_TTL_S="1.0",
+               RACON_TPU_FAULTS="serve.polish:io@1*",
+               RACON_TPU_EXEC_BACKOFF_S="120")
+    with open(log_path, "wb") as log:
+        sick = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu", "--serve", sick_sock,
+             "--fleet-dir", fleet_dir, "-w", "150", "-t", "2"],
+            cwd=REPO_ROOT, env=env, stderr=log)
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sick_sock):
+            assert time.monotonic() < deadline, \
+                "sick host did not start"
+            assert sick.poll() is None, "sick host died at startup"
+            time.sleep(0.1)
+        with _Gate(fleet_dir) as gate:
+            gate.wait_hosts(1)
+            with gate.client() as c:
+                sub = c.submit(_spec(reads, paf, layout), key="mg-1")
+                assert sub["ok"]
+                jid = sub["job"]
+                # wait until the job is leased and placed on the
+                # doomed host
+                deadline = time.monotonic() + 60
+                while True:
+                    row = c.status(jid)
+                    if row.get("state") == "placed" and \
+                            row.get("host") == "sick":
+                        break
+                    assert time.monotonic() < deadline, row
+                    time.sleep(0.05)
+            os.kill(sick.pid, signal.SIGKILL)
+            sick.wait(timeout=30)
+            # a healthy survivor joins AFTER the kill: the migration
+            # target
+            with _Host(short_tmp, "h1", fleet_dir, num_threads=2):
+                gate.wait_hosts(1)
+                with gate.client(timeout_s=300) as c:
+                    header, payload = c.result(jid, timeout_s=240)
+                    assert header["ok"], header
+                    assert payload == want, (
+                        "migrated result diverged from the one-shot "
+                        "CLI")
+                    st = c.stats()
+                    assert st["migrated"] >= 1, st
+                    assert st["hosts"]["dead"] >= 1, st
+                    row = c.status(jid)
+                    assert row.get("host") == "h1"
+                    assert row.get("migrations", 0) >= 1
+                # journal truth — zero lost, zero duplicated: one
+                # submitted record, a running record per incarnation
+                # (>=2: sick then survivor), exactly one done and one
+                # collected (read before shutdown compacts the
+                # collected job away)
+                kinds = {}
+                for r in _journal_records(fleet_dir):
+                    if r.get("job") == jid:
+                        kinds[r["rec"]] = kinds.get(r["rec"], 0) + 1
+                assert kinds.get("submitted") == 1
+                assert kinds.get("running", 0) >= 2
+                assert kinds.get("done") == 1
+                assert kinds.get("collected") == 1
+    finally:
+        if sick.poll() is None:
+            sick.kill()
+            sick.wait()
+
+
+def test_gateway_cli_entry(short_tmp, fast_fleet):
+    """``racon --gateway HOST:PORT --fleet-dir DIR`` and ``racon
+    --submit host:port --tenant --priority`` wire the fleet end to
+    end through the real CLI surface."""
+    reads, paf, layout = _assembly(short_tmp, [1800], prefix="cl")
+    want = _oneshot_cli(reads, paf, layout)
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    import socket as socket_mod
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RACON_TPU_SERVE_WARM_SHAPES="",
+               RACON_TPU_FLEET_HOST_TTL_S="1.0",
+               RACON_TPU_FLEET_POLL_S="0.05")
+    with open(os.path.join(short_tmp, "gw.log"), "wb") as log:
+        gw = subprocess.Popen(
+            [sys.executable, "-m", "racon_tpu",
+             "--gateway", f"127.0.0.1:{port}",
+             "--fleet-dir", fleet_dir],
+            cwd=REPO_ROOT, env=env, stderr=log)
+    try:
+        deadline = time.monotonic() + 120
+        while True:
+            assert gw.poll() is None, "gateway process died"
+            assert time.monotonic() < deadline, \
+                "gateway never answered"
+            try:
+                with ServiceClient(f"127.0.0.1:{port}", timeout_s=5,
+                                   retries=0) as c:
+                    if c.ping().get("ok"):
+                        break
+            except (OSError, ConnectionError):
+                time.sleep(0.1)
+        with _Host(short_tmp, "h0", fleet_dir, num_threads=2):
+            proc = subprocess.run(
+                [sys.executable, "-m", "racon_tpu",
+                 "--submit", f"127.0.0.1:{port}",
+                 "--tenant", "alpha", "--priority", "2",
+                 "-w", "150", "-t", "2", reads, paf, layout],
+                capture_output=True, timeout=600, cwd=REPO_ROOT,
+                env=env)
+            assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+            assert proc.stdout == want, (
+                "--submit through the gateway diverged from the "
+                "one-shot CLI")
+        with ServiceClient(f"127.0.0.1:{port}", timeout_s=30) as c:
+            c.shutdown("now")
+        gw.wait(timeout=60)
+    finally:
+        if gw.poll() is None:
+            gw.kill()
+            gw.wait()
