@@ -87,7 +87,7 @@ METRICS: FrozenSet[str] = frozenset((
     # fleet gateway + placement (gateway-process-lifetime, unscoped)
     "fleet.cost_cache_hits", "fleet.cost_cache_misses",
     "fleet.hosts_alive", "fleet.hosts_dead", "fleet.migrated",
-    "fleet.placed", "fleet.preempted",
+    "fleet.placed", "fleet.preempted", "fleet.reject_requeued",
     "gateway.accepted", "gateway.rejected",
     # lease lifecycle
     "lease.claimed", "lease.expired", "lease.lost", "lease.reclaimed",
@@ -533,6 +533,10 @@ PLACEMENT_MACHINE = StateMachine(
     states=(HOST_REGISTERED, HOST_ALIVE, HOST_SILENT, HOST_DEAD),
     edges=(
         (HOST_REGISTERED, HOST_ALIVE),
+        # registered->dead: the gateway's FIRST sight of a beacon can
+        # already be stale past the TTL (host crashed before the
+        # gateway started) — declared dead without ever being alive
+        (HOST_REGISTERED, HOST_DEAD),
         (HOST_ALIVE, HOST_SILENT),
         (HOST_SILENT, HOST_ALIVE), (HOST_SILENT, HOST_DEAD),
         (HOST_DEAD, HOST_ALIVE),

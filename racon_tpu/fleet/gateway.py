@@ -73,6 +73,20 @@ H_DEAD = contracts.HOST_DEAD
 
 DEFAULT_RESULT_TIMEOUT_S = 600.0
 
+# host submit-rejection answers that are HOST-LOCAL, not verdicts on
+# the job: a queue filled by direct (non-gateway) submissions, a
+# member started with a smaller --serve-budget, a drain in progress.
+# These requeue (bounded, with the rejecting host deprioritized);
+# everything else — profile mismatch, bad spec — is deterministic and
+# fails the job terminally.
+TRANSIENT_REJECT_MARKERS = ("queue full", "exceeds the service budget",
+                            "draining")
+MAX_TRANSIENT_REJECTS = 32
+
+
+def _rejection_is_transient(error: str) -> bool:
+    return any(m in error for m in TRANSIENT_REJECT_MARKERS)
+
 
 def parse_gateway_address(address: str) -> Tuple[str, int]:
     """``HOST:PORT`` (port 0 = ephemeral, host empty = loopback)."""
@@ -120,6 +134,15 @@ class FleetJob:
         self.prior_key: Optional[str] = None
         self.preempt_requested = False
         self.migrations = 0
+        # host-local rejections (queue full, smaller budget, drain):
+        # requeue-and-try-elsewhere bookkeeping, never terminal on
+        # the first answer
+        self.host_rejects = 0
+        self.rejected_hosts: set = set()
+        # answered FAILED in RAM by a hard stop, but still journaled
+        # `submitted` on disk: the final compaction must keep it live
+        # so the restarted gateway runs it
+        self.shutdown_orphan = False
         # collected result (always spooled: the gateway is durable by
         # construction — no fleet journal, no gateway)
         self.spool: Optional[str] = None
@@ -182,7 +205,10 @@ class Gateway:
         # counts, and how many jobs are placed on each
         self._host_info: Dict[str, dict] = {}
         self._host_stage: Dict[str, str] = {}
-        self._host_workers: Dict[str, int] = {}
+        # advertised healthy-worker counts, (count, fetched_monotonic):
+        # entries age out over the host TTL (slot quarantine shrinks a
+        # live host's count) and drop on death or re-registration
+        self._host_workers: Dict[str, Tuple[int, float]] = {}
         self._placed: Dict[str, FleetJob] = {}
         self._draining = False
         self._stop = threading.Event()
@@ -203,6 +229,19 @@ class Gateway:
                 f"fleet job {job.id}: undeclared tenant transition "
                 f"{job.stage!r} -> {stage!r}")
         job.stage = stage
+
+    def _host_advance_locked(self, name: str, stage: str) -> None:
+        """Move a host along the declared ``placement`` machine (a
+        same-state write is a no-op, not a transition) — like
+        :meth:`_advance`, an undeclared edge is a bug."""
+        prev = self._host_stage.get(name, H_REGISTERED)
+        if prev == stage:
+            return
+        if not contracts.PLACEMENT_MACHINE.has_edge(prev, stage):
+            raise AssertionError(
+                f"fleet host {name}: undeclared placement transition "
+                f"{prev!r} -> {stage!r}")
+        self._host_stage[name] = stage  # graftlint: disable=lock-discipline (caller holds _lock)
 
     def _retire_locked(self, job: FleetJob) -> None:
         """Terminal bookkeeping under the state lock: counts, the
@@ -419,24 +458,31 @@ class Gateway:
             for name in sorted(names):
                 prev = self._host_stage.get(name, H_REGISTERED)
                 info = beacons.get(name)
+                cached = self._host_info.get(name)
+                if info is not None and cached is not None and (
+                        info.get("pid") != cached.get("pid")
+                        or info.get("registered_unix")
+                        != cached.get("registered_unix")):
+                    # same name, new incarnation: the restarted host
+                    # may run fewer workers — re-learn the count
+                    self._host_workers.pop(name, None)
                 if info is None or info["age_s"] > ttl:
                     # withdrawn beacon = clean goodbye; stale past the
                     # TTL = presumed dead — either way placements on
                     # it must move
-                    if prev in (H_ALIVE,):
-                        self._host_stage[name] = H_SILENT
+                    if prev == H_ALIVE:
+                        self._host_advance_locked(name, H_SILENT)
                         prev = H_SILENT
-                    if prev in (H_SILENT, H_REGISTERED) and \
-                            (info is None or info["age_s"] > ttl):
-                        if prev != H_DEAD:
-                            self._host_stage[name] = H_DEAD
-                            newly_dead.append(name)
-                            metrics.inc("fleet.hosts_dead")
+                    if prev in (H_SILENT, H_REGISTERED):
+                        self._host_advance_locked(name, H_DEAD)
+                        self._host_workers.pop(name, None)
+                        newly_dead.append(name)
+                        metrics.inc("fleet.hosts_dead")
                 elif info["age_s"] > ttl / 2.0:
                     if prev == H_ALIVE:
-                        self._host_stage[name] = H_SILENT
+                        self._host_advance_locked(name, H_SILENT)
                 else:
-                    self._host_stage[name] = H_ALIVE
+                    self._host_advance_locked(name, H_ALIVE)
                 if info is not None:
                     self._host_info[name] = info
             alive = sum(1 for s in self._host_stage.values()
@@ -465,11 +511,18 @@ class Gateway:
         sock = self._host_socket(name)
         if sock is None:
             return 0
+        now = time.monotonic()
         with self._lock:
-            workers = self._host_workers.get(name)
+            entry = self._host_workers.get(name)
             load = sum(1 for j in self._placed.values()
                        if j.host == name)
+        workers: Optional[int] = None
+        if entry is not None and now - entry[1] <= \
+                registry.host_ttl_s():
+            workers = entry[0]
         if workers is None:
+            # first sight, stale, or invalidated by death /
+            # re-registration: re-learn the advertised count
             try:
                 with ServiceClient(sock, timeout_s=10.0,
                                    retries=0) as client:
@@ -478,7 +531,7 @@ class Gateway:
             except (OSError, ConnectionError):
                 return 0
             with self._lock:
-                self._host_workers[name] = workers
+                self._host_workers[name] = (workers, now)
         return max(0, workers - load)
 
     # ---------------------------------------------------------- placement
@@ -525,14 +578,33 @@ class Gateway:
                      f"({type(e).__name__}: {e}) — requeued")
                 return False
             if not resp.get("ok"):
-                # a deterministic host rejection (budget, profile) is
-                # the job's answer — every member shares the profile,
-                # so another host would say the same
                 lease.release()
+                err = str(resp.get("error") or "")
+                if _rejection_is_transient(err):
+                    # host-local answer: another member (or this one,
+                    # later) may accept — requeue, deprioritize the
+                    # rejecting host, and only give up after a bound
+                    # so a fleet that can never take the job still
+                    # answers the client
+                    job.host_rejects += 1
+                    job.rejected_hosts.add(host)
+                    if job.host_rejects < MAX_TRANSIENT_REJECTS:
+                        metrics.inc("fleet.reject_requeued")
+                        warn(f"fleet: host {host} rejected {job.id} "
+                             f"({err}) — requeued (attempt "
+                             f"{job.host_rejects}/"
+                             f"{MAX_TRANSIENT_REJECTS})")
+                        return False
+                    err = (f"rejected by {job.host_rejects} placement "
+                           f"attempt(s), last by host {host}: {err}")
+                else:
+                    # deterministic (profile mismatch, bad spec): the
+                    # rejection IS the job's answer — every member
+                    # compiled the same profile would say the same
+                    err = f"rejected by host {host}: {err}"
                 with self._cond:
                     self._advance(job, FAILED)
-                    job.error = f"rejected by host {host}: " \
-                                f"{resp.get('error')}"
+                    job.error = err
                     self._retire_locked(job)
                 try:
                     self._journal.append({"rec": "failed",
@@ -552,6 +624,8 @@ class Gateway:
                 job.run_records.append(rec)
                 job.lease = lease
                 job.preempt_requested = False
+                job.host_rejects = 0
+                job.rejected_hosts.clear()
                 self._placed[job.id] = job
         metrics.inc("fleet.placed")
         metrics.inc(f"fleet.tenant.{job.tenant}.placed")
@@ -800,6 +874,12 @@ class Gateway:
             # most-free-slots first: least-loaded-by-outstanding work
             hosts.sort(key=lambda hc: (-hc[1], hc[0]))
             target = hosts[0][0]
+            # a host that already rejected this job (queue full,
+            # smaller budget) comes last: try the others first
+            for name, _ in hosts:
+                if name not in job.rejected_hosts:
+                    target = name
+                    break
             try:
                 if not self._place(job, target):
                     with self._cond:
@@ -1001,19 +1081,21 @@ class Gateway:
         header = {"ok": job.stage == DONE, **job.row(),
                   "report": job.report}
         if job.stage != DONE:
+            if job.stage == COLLECTED:
+                # collection advanced DONE -> COLLECTED: a second
+                # fetch lands here, and deserves the why
+                header["error"] = (
+                    f"job {job.id} result was already collected "
+                    f"(payloads are retained for one successful "
+                    f"fetch)")
             protocol.send_msg(conn, header)
             return True
-        with self._lock:
-            collected = job.collected
-        blob = None if collected else self._journal.spool_read(
-            job.id, job.result_bytes, job.crc32)
+        blob = self._journal.spool_read(job.id, job.result_bytes,
+                                        job.crc32)
         if blob is None:
             header.update(ok=False, error=(
-                f"job {job.id} result "
-                + ("was already collected (payloads are retained "
-                   "for one successful fetch)" if collected
-                   else "spool failed verification — resubmit under "
-                        "a fresh key to re-run it")))
+                f"job {job.id} result spool failed verification — "
+                f"resubmit under a fresh key to re-run it"))
             protocol.send_msg(conn, header)
             return True
         header["bytes"] = len(blob)
@@ -1120,7 +1202,7 @@ class Gateway:
                              "wall_s": round(job.wall_s, 3),
                              "engine": job.engine})
                 keep.append(job.id)
-            elif job.stage == FAILED:
+            elif job.stage == FAILED and not job.shutdown_orphan:
                 live.append({"rec": "failed", "job": job.id,
                              "error": job.error or ""})
             elif job.stage == CANCELLED:
@@ -1168,6 +1250,7 @@ class Gateway:
                     break
                 _, job = popped
                 job.stage = FAILED
+                job.shutdown_orphan = True
                 job.error = ("gateway shutdown before the job "
                              "placed — it is journaled and will "
                              "recover on restart from the same "

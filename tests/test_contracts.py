@@ -43,6 +43,10 @@ def test_state_machines_declare_the_lifecycles():
     assert shard.has_edge("quarantined", "pending")
     assert shard.terminal == ()                     # every state requeues
     assert lease.has_edge("expired", "claimed")
+    placement = contracts.PLACEMENT_MACHINE
+    # first-sight-stale beacon / restart under the same name
+    assert placement.has_edge("registered", "dead")
+    assert placement.has_edge("dead", "alive")
     assert "zombie" not in job and "pending" in shard
     # the journal's record alphabet is a subset of the job states
     assert set(contracts.JOURNAL_RECORDS) <= set(job.states)

@@ -337,6 +337,11 @@ def test_gateway_round_trip_byte_identity(short_tmp, fast_fleet):
             assert payload == want, \
                 "gateway result diverged from the one-shot CLI"
             assert header["host"] in ("h0", "h1")
+            # retention: the payload is handed out once, and the
+            # second fetch says WHY (stage is COLLECTED by now)
+            again, payload2 = c.result(sub["job"], timeout_s=10)
+            assert payload2 is None and not again["ok"]
+            assert "already collected" in again["error"], again
             # fleet-wide idempotency: same key -> the existing job
             dup = c.submit(_spec(reads, paf, layout, tenant="alpha"),
                            key="rt-1")
@@ -493,6 +498,108 @@ def test_gateway_restart_serves_done_from_spool(short_tmp, fast_fleet):
             assert header["ok"], header
             assert payload == want, \
                 "recovered fleet result diverged from the one-shot CLI"
+
+
+def test_gateway_shutdown_now_requeues_on_restart(short_tmp,
+                                                  fast_fleet):
+    """``shutdown now`` with jobs still queued: the RAM answer is
+    FAILED, but the compacted journal keeps them LIVE (submitted, no
+    failed record) — the restarted gateway re-queues and runs them,
+    exactly what the shutdown docstring and the client error text
+    promise."""
+    reads, paf, layout = _assembly(short_tmp, [1500], prefix="sn")
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    # no hosts: the job is admitted and journaled but never places
+    with _Gate(fleet_dir) as gate:
+        with gate.client() as c:
+            sub = c.submit(_spec(reads, paf, layout), key="sn-1")
+            assert sub["ok"]
+            jid = sub["job"]
+    # hard stop compacted the journal: the queued job stays live
+    recs = _journal_records(fleet_dir)
+    kinds = [r["rec"] for r in recs if r.get("job") == jid]
+    assert "submitted" in kinds
+    assert "failed" not in kinds, \
+        "shutdown(now) made a queued job durably FAILED"
+    # the restarted gateway re-queues it and a host runs it
+    with _Host(short_tmp, "h0", fleet_dir, num_threads=2), \
+            _Gate(fleet_dir) as gate:
+        assert gate.gateway.recovery["jobs_recovered"] >= 1
+        gate.wait_hosts(1)
+        with gate.client() as c:
+            dup = c.submit(_spec(reads, paf, layout), key="sn-1")
+            assert dup["ok"] and dup["existing"] and dup["job"] == jid
+            header, payload = c.result(jid, timeout_s=240)
+            assert header["ok"], header
+            assert payload.startswith(b">ctg0")
+
+
+def test_host_local_rejection_routes_to_another_host(short_tmp,
+                                                     fast_fleet):
+    """A host submit rejection that is HOST-LOCAL (here: a member
+    started with a tiny --serve-budget) requeues the job and the next
+    tick tries a different host — it must not terminally fail a job
+    another member would accept."""
+    reads, paf, layout = _assembly(short_tmp, [1500], prefix="hr")
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    before = metrics.counter("fleet.reject_requeued")
+    # "a0" sorts first for placement (2 free slots vs 1) but rejects
+    # everything: its budget is one KB
+    with _Host(short_tmp, "a0", fleet_dir, num_threads=2,
+               budget_bytes=1024), \
+            _Host(short_tmp, "z1", fleet_dir, num_threads=1), \
+            _Gate(fleet_dir) as gate:
+        gate.wait_hosts(2)
+        with gate.client() as c:
+            sub = c.submit(_spec(reads, paf, layout))
+            assert sub["ok"]
+            header, payload = c.result(sub["job"], timeout_s=240)
+            assert header["ok"], header
+            assert header["host"] == "z1"
+            assert payload.startswith(b">ctg0")
+    assert metrics.counter("fleet.reject_requeued") > before
+    assert not any(r.get("rec") == "failed"
+                   for r in _journal_records(fleet_dir))
+
+
+def test_host_worker_cache_invalidation(short_tmp, fast_fleet):
+    """The cached advertised-worker count drops when a host dies or
+    re-registers under the same name (a restart may come back with
+    fewer workers), and a first-ever-seen beacon already stale past
+    the TTL walks the declared registered->dead edge."""
+    fleet_dir = os.path.join(short_tmp, "fleet")
+    hx = HostBeacon(fleet_dir, os.path.join(short_tmp, "hx.sock"))
+    hy = HostBeacon(fleet_dir, os.path.join(short_tmp, "hy.sock"))
+    stale = time.time() - 60
+    gw = Gateway("127.0.0.1:0", fleet_dir)
+    try:
+        hx.announce()
+        hy.announce()
+        os.utime(hy.path, (stale, stale))
+        gw._refresh_hosts()
+        # hy was stale on FIRST sight: registered -> dead, asserted
+        # against the placement machine (no silent contract drift)
+        assert gw._host_stage["hx"] == "alive"
+        assert gw._host_stage["hy"] == "dead"
+        # dead -> the cached worker count is dropped
+        gw._host_workers["hx"] = (4, time.monotonic())
+        os.utime(hx.path, (stale, stale))
+        gw._refresh_hosts()
+        assert gw._host_stage["hx"] == "dead"
+        assert "hx" not in gw._host_workers
+        # same name, new incarnation (registered_unix moves): the
+        # dead -> alive edge re-learns the count too
+        time.sleep(0.01)
+        hx.announce()
+        gw._refresh_hosts()
+        assert gw._host_stage["hx"] == "alive"
+        gw._host_workers["hx"] = (4, time.monotonic())
+        time.sleep(0.01)
+        hx.announce()  # restarted again while alive
+        gw._refresh_hosts()
+        assert "hx" not in gw._host_workers
+    finally:
+        gw._journal.close()
 
 
 def test_fleet_preemption_chaos(short_tmp, fast_fleet):
